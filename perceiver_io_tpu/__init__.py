@@ -1,0 +1,48 @@
+"""perceiver-io-tpu: a TPU-native (JAX/XLA/Pallas) framework with the capabilities
+of krasserm/perceiver-io — Perceiver, Perceiver IO, and Perceiver AR model families.
+
+Public API re-exports; see SURVEY.md for the component map against the reference.
+"""
+
+from perceiver_io_tpu.models.core.adapter import (
+    ClassificationOutputAdapter,
+    InputAdapter,
+    TiedTokenOutputAdapter,
+    TokenInputAdapter,
+    TokenInputAdapterWithRotarySupport,
+    TokenOutputAdapter,
+    TrainableQueryProvider,
+)
+from perceiver_io_tpu.models.core.config import (
+    CausalSequenceModelConfig,
+    ClassificationDecoderConfig,
+    DecoderConfig,
+    EncoderConfig,
+    PerceiverARConfig,
+    PerceiverIOConfig,
+)
+from perceiver_io_tpu.models.core.modules import (
+    MLP,
+    CrossAttention,
+    CrossAttentionLayer,
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverIO,
+    SelfAttention,
+    SelfAttentionBlock,
+    SelfAttentionLayer,
+)
+from perceiver_io_tpu.models.core.perceiver_ar import (
+    CausalSequenceModel,
+    PerceiverAR,
+    PerceiverARCache,
+)
+from perceiver_io_tpu.ops.attention import KVCache, MultiHeadAttention
+from perceiver_io_tpu.ops.position import (
+    RotaryPositionEmbedding,
+    fourier_position_encodings,
+    frequency_position_encoding,
+    positions,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,181 @@
+"""Input/output adapters and query providers.
+
+Parity targets (reference: /root/reference/perceiver/model/core/adapter.py):
+  - ``InputAdapter``                  -> adapter.py:8-19
+  - ``RotarySupport`` mixin           -> adapter.py:22-33 (here folded into
+    ``TokenInputAdapterWithRotarySupport`` which returns (embeddings, rope angles))
+  - ``ClassificationOutputAdapter``   -> adapter.py:39-49
+  - ``TrainableQueryProvider``        -> adapter.py:63-83 (the latent array)
+  - ``TokenInputAdapter``             -> adapter.py:86-114 (right-most position
+    codes when decoding with fewer tokens than positions, adapter.py:109-111)
+  - ``TiedTokenOutputAdapter``        -> adapter.py:138-150
+
+JAX notes: adapters are flax modules; the tied LM head receives the embedding
+matrix explicitly (functional param sharing instead of torch's module-attribute
+access).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.position import frequency_position_encoding, positions
+
+
+class InputAdapter(nn.Module):
+    """Transforms and position-encodes task-specific input to generic encoder input."""
+
+    @property
+    def num_input_channels(self) -> int:
+        raise NotImplementedError
+
+
+class TrainableQueryProvider(nn.Module):
+    """Learnable cross-attention query input: the latent array in Perceiver IO
+    encoders and the output query array in most decoders."""
+
+    num_queries: int
+    num_query_channels_: int
+    init_scale: float = 0.02
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_query_channels(self) -> int:
+        return self.num_query_channels_
+
+    @nn.compact
+    def __call__(self, x: Optional[jax.Array] = None) -> jax.Array:
+        query = self.param(
+            "query",
+            nn.initializers.normal(stddev=self.init_scale),
+            (self.num_queries, self.num_query_channels_),
+            self.param_dtype,
+        )
+        return query[None, ...]
+
+
+class TokenInputAdapter(InputAdapter):
+    """Token embedding + optional learned absolute position embedding."""
+
+    vocab_size: int
+    max_seq_len: int
+    num_input_channels_: int
+    abs_pos_emb: bool = True
+    init_scale: float = 0.02
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_input_channels_
+
+    def setup(self):
+        emb = lambda n, name: nn.Embed(
+            n,
+            self.num_input_channels_,
+            embedding_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name=name,
+        )
+        self.txt_embedding = emb(self.vocab_size, "txt_embedding")
+        if self.abs_pos_emb:
+            self.pos_embedding = emb(self.max_seq_len, "pos_embedding")
+
+    def embed(self, x: jax.Array, abs_pos: Optional[jax.Array] = None) -> jax.Array:
+        if self.abs_pos_emb:
+            if abs_pos is None:
+                abs_pos = positions(*x.shape)
+            elif x.shape[1] < abs_pos.shape[1]:
+                # use right-most position codes (cached decode feeds only new tokens)
+                abs_pos = abs_pos[:, -x.shape[1] :]
+            return self.txt_embedding(x) + self.pos_embedding(abs_pos)
+        return self.txt_embedding(x)
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Tied-embedding readout: x @ E^T (the functional form of the reference's
+        TiedTokenOutputAdapter matmul, adapter.py:145-150)."""
+        return self.txt_embedding.attend(x)
+
+    def __call__(self, x: jax.Array, abs_pos: Optional[jax.Array] = None) -> jax.Array:
+        return self.embed(x, abs_pos)
+
+
+class TokenInputAdapterWithRotarySupport(TokenInputAdapter):
+    """Token input adapter that also returns rotary phase angles for the given
+    absolute positions (reference RotarySupport mixin, adapter.py:22-33)."""
+
+    rotated_channels_per_head: int = 0
+
+    def __call__(
+        self, x: jax.Array, abs_pos: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        if abs_pos is None:
+            abs_pos = positions(*x.shape)
+        return (
+            self.embed(x, abs_pos),
+            frequency_position_encoding(abs_pos, self.rotated_channels_per_head),
+        )
+
+
+class ClassificationOutputAdapter(nn.Module):
+    num_classes: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="linear",
+        )(x)
+        if x.shape[1] == 1:
+            x = jnp.squeeze(x, axis=1)
+        return x
+
+
+class TokenOutputAdapter(nn.Module):
+    """Untied LM head (used by the masked LM when a separate output width is set)."""
+
+    vocab_size: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return nn.Dense(
+            self.vocab_size,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="linear",
+        )(x)
+
+
+class TiedTokenOutputAdapter(nn.Module):
+    """Bias half of the tied LM head. The matmul with the transposed embedding
+    happens via ``TokenInputAdapter.attend`` (flax's idiomatic ``nn.Embed.attend``);
+    this module only owns the optional output bias so the parameter layout mirrors
+    the reference's TiedTokenOutputAdapter (adapter.py:138-150)."""
+
+    vocab_size: int
+    emb_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tied_logits: jax.Array) -> jax.Array:
+        if self.emb_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.vocab_size,), self.param_dtype)
+            return tied_logits + bias.astype(tied_logits.dtype)
+        return tied_logits

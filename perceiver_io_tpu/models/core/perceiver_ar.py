@@ -1,0 +1,396 @@
+"""Perceiver AR: long-context causal modeling via latent compression.
+
+Parity targets (reference: /root/reference/perceiver/model/core/modules.py):
+  - ``PerceiverAR``          -> modules.py:691-871. Split the input at ``prefix_len``
+    into prefix + latents; latents attend causally to concat(prefix, latents) via one
+    cross-attention (``x_kv_prefix`` mode, right-aligned causal mask), then a causal
+    self-attention stack runs over the latents only. RoPE angles come from a
+    frequency encoding of pad-shifted absolute positions. Training-time
+    cross-attention (prefix) dropout randomly keeps a fixed-size subset of prefix
+    positions (modules.py:809-830).
+  - ``CausalSequenceModel``  -> modules.py:874-930 (token adapter + optional final
+    LN + tied token head; RoPE over half the head channels when abs-pos-emb on).
+
+TPU-first design notes:
+  * torch overloads one ``forward`` across training, prefill, and cached decode with
+    dynamic shapes. Here the three paths are explicit methods with static shapes:
+    ``__call__`` (uncached), ``prefill`` (fills fixed-capacity caches), and
+    ``decode_step`` (one token; caches roll when full, which reproduces the
+    reference HF wrapper's latent->prefix->slide window policy,
+    core/huggingface.py:89-156).
+  * Prefix dropout keeps a *static* count ``prefix_len - int(prefix_len * p)`` of
+    positions (the reference computes the same count at modules.py:817), realised as
+    a sorted top-k gather — a static-shape operation XLA can fuse, in place of
+    torch's boolean-mask reshape.
+  * Decode positions are derived from cache slot indices: slot ``j`` of the
+    cross-attention cache is sequence position ``j`` (minus the per-example left-pad
+    shift, clamped at 0 — reference position.py:9-17), so RoPE tables are computed
+    from ``arange(capacity)`` with no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import (
+    TiedTokenOutputAdapter,
+    TokenInputAdapterWithRotarySupport,
+)
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.modules import LN_EPS, CrossAttentionLayer, SelfAttentionBlock
+from perceiver_io_tpu.ops.attention import KVCache
+from perceiver_io_tpu.ops.position import frequency_position_encoding, positions
+
+
+class PerceiverARCache(flax.struct.PyTreeNode):
+    """Decode state for Perceiver AR.
+
+    ``ca``: cross-attention KV cache, capacity ``max_seq_len`` (keys/values of the
+        whole sliding window: prefix + latents).
+    ``sa``: stacked per-layer self-attention KV caches, capacity ``max_latents``.
+    ``pad_slots``: (B, max_seq_len) boolean, True where a cross-attention cache slot
+        holds a padding token; rolled in lockstep with ``ca``.
+    ``shift``: (B, 1) int32 left-pad count (constant per sequence), subtracted from
+        positions before clamping at 0.
+    """
+
+    ca: KVCache
+    sa: KVCache
+    pad_slots: jax.Array
+    shift: jax.Array
+
+    @property
+    def seq_len(self) -> jax.Array:
+        return self.ca.length
+
+
+class PerceiverAR(nn.Module):
+    """Generic Perceiver AR over an input adapter with rotary support."""
+
+    input_adapter: nn.Module
+    num_heads: int = 8
+    max_heads_parallel: Optional[int] = None
+    num_self_attention_layers: int = 6
+    num_self_attention_rotary_layers: int = 1
+    self_attention_widening_factor: int = 4
+    cross_attention_widening_factor: int = 4
+    cross_attention_dropout: float = 0.5
+    post_attention_dropout: float = 0.0
+    residual_dropout: float = 0.0
+    activation_checkpointing: bool = False
+    init_scale: float = 0.02
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        num_channels = self.input_adapter.num_input_channels
+        self.cross_attention = CrossAttentionLayer(
+            num_heads=self.num_heads,
+            num_q_input_channels=num_channels,
+            num_kv_input_channels=num_channels,
+            max_heads_parallel=self.max_heads_parallel,
+            causal_attention=True,
+            widening_factor=self.cross_attention_widening_factor,
+            dropout=self.post_attention_dropout,
+            residual_dropout=self.residual_dropout,
+            qkv_bias=False,
+            out_bias=True,
+            mlp_bias=False,
+            init_scale=self.init_scale,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="cross_attention",
+        )
+        self.self_attention = SelfAttentionBlock(
+            num_layers=self.num_self_attention_layers,
+            num_heads=self.num_heads,
+            num_channels=num_channels,
+            causal_attention=True,
+            widening_factor=self.self_attention_widening_factor,
+            dropout=self.post_attention_dropout,
+            residual_dropout=self.residual_dropout,
+            num_rotary_layers=self.num_self_attention_rotary_layers,
+            activation_checkpointing=self.activation_checkpointing,
+            qkv_bias=False,
+            out_bias=False,
+            mlp_bias=False,
+            init_scale=self.init_scale,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="self_attention",
+        )
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Tied-embedding readout, delegated to the input adapter."""
+        return self.input_adapter.attend(x)
+
+    # ------------------------------------------------------------------ uncached
+    def __call__(
+        self,
+        x: jax.Array,
+        prefix_len: int,
+        pad_mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Uncached forward over tokens ``x`` (B, N) with a static ``prefix_len``.
+        Returns latent hidden states (B, N - prefix_len, C)."""
+        b, n = x.shape
+        if not 0 <= prefix_len < n:
+            raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
+
+        shift = None if pad_mask is None else jnp.sum(pad_mask, axis=1, keepdims=True)
+        x_emb, frq_pos_enc = self.input_adapter(x, abs_pos=positions(b, n, shift=shift))
+
+        x_latent = x_emb[:, prefix_len:]
+        x_prefix = x_emb[:, :prefix_len]
+        frq_latent = frq_pos_enc[:, prefix_len:]
+        frq_prefix = frq_pos_enc[:, :prefix_len]
+        pad_latent = None if pad_mask is None else pad_mask[:, prefix_len:]
+        pad_prefix = None if pad_mask is None else pad_mask[:, :prefix_len]
+
+        if (not self.deterministic) and prefix_len > 0 and self.cross_attention_dropout > 0.0:
+            # Cross-attention (prefix) dropout: keep a static-count random subset of
+            # prefix positions, order-preserving (reference modules.py:809-830).
+            keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
+            rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+            _, keep_idx = jax.lax.top_k(rand, keep)
+            keep_idx = jnp.sort(keep_idx, axis=1)
+            x_prefix = jnp.take_along_axis(x_prefix, keep_idx[..., None], axis=1)
+            frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
+            if pad_prefix is not None:
+                pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
+
+        rope_q = frq_latent
+        rope_k = jnp.concatenate([frq_prefix, frq_latent], axis=1)
+        pad_full = None if pad_mask is None else jnp.concatenate([pad_prefix, pad_latent], axis=1)
+
+        x_latent, _ = self.cross_attention(
+            x_latent, x_kv_prefix=x_prefix, pad_mask=pad_full, rope_q=rope_q, rope_k=rope_k
+        )
+        x_latent, _ = self.self_attention(x_latent, rope_q=frq_latent, rope_k=frq_latent)
+        return x_latent
+
+    # ------------------------------------------------------------------- cached
+    def init_cache(
+        self, batch_size: int, max_seq_len: int, max_latents: int, dtype=jnp.float32
+    ) -> PerceiverARCache:
+        # Built from constructor fields only, so it works on an unbound module
+        # (no params or setup state involved).
+        num_channels = self.input_adapter.num_input_channels
+        num_layers = self.num_self_attention_layers
+        return PerceiverARCache(
+            ca=KVCache.create(batch_size, max_seq_len, num_channels, num_channels, dtype),
+            sa=KVCache(
+                k=jnp.zeros((num_layers, batch_size, max_latents, num_channels), dtype),
+                v=jnp.zeros((num_layers, batch_size, max_latents, num_channels), dtype),
+                length=jnp.zeros((num_layers,), jnp.int32),
+            ),
+            pad_slots=jnp.zeros((batch_size, max_seq_len), dtype=bool),
+            shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+        )
+
+    def _rotated_dim(self) -> int:
+        return self.input_adapter.rotated_channels_per_head
+
+    def prefill(
+        self,
+        x: jax.Array,
+        prefix_len: int,
+        cache: PerceiverARCache,
+        pad_mask: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, PerceiverARCache]:
+        """Process a full prompt (B, N) into empty caches; N - prefix_len latents.
+        Prefix dropout must be off (deterministic instance) — reference raises the
+        same way for cache + dropout (modules.py:810-812)."""
+        if not self.deterministic:
+            raise ValueError("cross-attention dropout not supported with caching")
+        b, n = x.shape
+        ca_cap = cache.ca.capacity
+        sa_cap = cache.sa.k.shape[2]
+        if not 0 <= prefix_len < n:
+            raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
+        if n > ca_cap or (n - prefix_len) > sa_cap:
+            raise ValueError("prompt does not fit cache capacities")
+
+        shift = (
+            jnp.zeros((b, 1), jnp.int32) if pad_mask is None else jnp.sum(pad_mask, axis=1, keepdims=True).astype(jnp.int32)
+        )
+        x_emb, frq = self.input_adapter(x, abs_pos=positions(b, n, shift=shift))
+
+        x_latent = x_emb[:, prefix_len:]
+        x_prefix = x_emb[:, :prefix_len]
+        frq_latent = frq[:, prefix_len:]
+
+        # RoPE table over cross-attention cache slots: slot j is position j - shift.
+        slot_pos = jnp.maximum(jnp.arange(ca_cap)[None, :] - shift, 0)
+        rope_k_ca = frequency_position_encoding(slot_pos, self._rotated_dim())
+
+        pad_slots = cache.pad_slots
+        if pad_mask is not None:
+            pad_slots = jnp.zeros((b, ca_cap), dtype=bool).at[:, :n].set(pad_mask)
+
+        x_latent, ca_cache = self.cross_attention(
+            x_latent,
+            x_kv_prefix=x_prefix,
+            pad_mask=pad_slots,
+            rope_q=frq_latent,
+            rope_k=rope_k_ca,
+            kv_cache=cache.ca,
+        )
+        # Self-attention cache slot j will hold latent j, i.e. sequence position
+        # prefix_len + j; the RoPE table must span the full cache capacity.
+        sa_slot_pos = jnp.maximum(prefix_len + jnp.arange(sa_cap)[None, :] - shift, 0)
+        rope_k_sa = frequency_position_encoding(sa_slot_pos, self._rotated_dim())
+        x_latent, sa_cache = self.self_attention(
+            x_latent, rope_q=frq_latent, rope_k=rope_k_sa, kv_cache=cache.sa
+        )
+        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=shift)
+        return x_latent, new_cache
+
+    def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
+        """One decode step with token(s) ``x`` (B, 1). The new token joins the
+        latents; full caches roll their oldest entry out (= the reference's sliding
+        window where the oldest latent is absorbed into the prefix)."""
+        b = x.shape[0]
+        assert x.shape[1] == 1, "decode_step processes one token at a time"
+        ca_cap = cache.ca.capacity
+        sa_cap = cache.sa.k.shape[2]
+        rot = self._rotated_dim()
+
+        n_after = jnp.minimum(cache.ca.length + 1, ca_cap)  # window length after append
+        q_pos = jnp.maximum(n_after - 1 - cache.shift, 0)  # (b, 1)
+
+        x_emb, frq_q = self.input_adapter(x, abs_pos=q_pos)
+
+        # Roll the pad-slot mask in lockstep with the cross-attention cache append.
+        full = cache.ca.length >= ca_cap
+        pad_slots = jnp.where(full, jnp.roll(cache.pad_slots, -1, axis=1), cache.pad_slots)
+        write_pos = jnp.minimum(cache.ca.length, ca_cap - 1)
+        pad_slots = jax.lax.dynamic_update_slice_in_dim(pad_slots, jnp.zeros((b, 1), bool), write_pos, axis=1)
+
+        slot_pos = jnp.maximum(jnp.arange(ca_cap)[None, :] - cache.shift, 0)
+        rope_k_ca = frequency_position_encoding(slot_pos, rot)
+
+        x_latent, ca_cache = self.cross_attention(
+            x_emb, x_kv_prefix=x_emb[:, :0], pad_mask=pad_slots, rope_q=frq_q, rope_k=rope_k_ca, kv_cache=cache.ca
+        )
+
+        # Self-attention cache slot j holds the (j+1)-th oldest latent; its sequence
+        # position is n_after - sa_len_after + j.
+        sa_len_after = jnp.minimum(cache.sa.length[0] + 1, sa_cap)
+        sa_slot_pos = n_after - sa_len_after + jnp.arange(sa_cap)[None, :]
+        sa_slot_pos = jnp.maximum(sa_slot_pos - cache.shift, 0)
+        rope_k_sa = frequency_position_encoding(sa_slot_pos, rot)
+
+        x_latent, sa_cache = self.self_attention(
+            x_latent, rope_q=frq_q, rope_k=rope_k_sa, kv_cache=cache.sa
+        )
+        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=cache.shift)
+        return x_latent, new_cache
+
+
+class CausalSequenceModel(nn.Module):
+    """Perceiver AR + token input adapter + optional final LN + tied token head."""
+
+    config: CausalSequenceModelConfig
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        num_rotated_channels = cfg.num_channels // cfg.num_heads
+        if cfg.abs_pos_emb:
+            # rotary embedding only for the first 50% of head channels
+            num_rotated_channels = num_rotated_channels // 2
+
+        input_adapter = TokenInputAdapterWithRotarySupport(
+            rotated_channels_per_head=num_rotated_channels,
+            vocab_size=cfg.vocab_size,
+            max_seq_len=cfg.max_seq_len,
+            num_input_channels_=cfg.num_channels,
+            abs_pos_emb=cfg.abs_pos_emb,
+            init_scale=cfg.init_scale,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.ar = PerceiverAR(
+            input_adapter=input_adapter,
+            num_heads=cfg.num_heads,
+            max_heads_parallel=cfg.max_heads_parallel,
+            num_self_attention_layers=cfg.num_self_attention_layers,
+            num_self_attention_rotary_layers=cfg.num_self_attention_rotary_layers,
+            self_attention_widening_factor=cfg.self_attention_widening_factor,
+            cross_attention_widening_factor=cfg.cross_attention_widening_factor,
+            cross_attention_dropout=cfg.cross_attention_dropout,
+            post_attention_dropout=cfg.post_attention_dropout,
+            residual_dropout=cfg.residual_dropout,
+            activation_checkpointing=cfg.activation_checkpointing,
+            init_scale=cfg.init_scale,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="ar",
+        )
+        if cfg.output_norm:
+            self.out_norm = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, param_dtype=self.param_dtype, name="out_norm")
+        self.output_adapter = TiedTokenOutputAdapter(
+            vocab_size=cfg.vocab_size, emb_bias=cfg.output_bias, param_dtype=self.param_dtype, name="output_adapter"
+        )
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.config.max_seq_len
+
+    @property
+    def max_latents(self) -> int:
+        return self.config.max_latents
+
+    @property
+    def max_prefix_len(self) -> int:
+        return self.config.max_seq_len - self.config.max_latents
+
+    def _head(self, hidden: jax.Array) -> jax.Array:
+        if self.config.output_norm:
+            hidden = self.out_norm(hidden)
+        return self.output_adapter(self.ar.attend(hidden))
+
+    def __call__(self, x: jax.Array, prefix_len: int, pad_mask: Optional[jax.Array] = None) -> jax.Array:
+        """Logits (B, N - prefix_len, vocab) over the latent positions."""
+        if prefix_len > self.max_prefix_len:
+            raise ValueError(f"prefix_len ({prefix_len}) exceeds max_prefix_len ({self.max_prefix_len})")
+        hidden = self.ar(x, prefix_len=prefix_len, pad_mask=pad_mask)
+        return self._head(hidden)
+
+    def init_cache(self, batch_size: int, dtype=jnp.float32) -> PerceiverARCache:
+        # Built from config only, so it works on an unbound module.
+        cfg = self.config
+        return PerceiverARCache(
+            ca=KVCache.create(batch_size, cfg.max_seq_len, cfg.num_channels, cfg.num_channels, dtype),
+            sa=KVCache(
+                k=jnp.zeros((cfg.num_self_attention_layers, batch_size, cfg.max_latents, cfg.num_channels), dtype),
+                v=jnp.zeros((cfg.num_self_attention_layers, batch_size, cfg.max_latents, cfg.num_channels), dtype),
+                length=jnp.zeros((cfg.num_self_attention_layers,), jnp.int32),
+            ),
+            pad_slots=jnp.zeros((batch_size, cfg.max_seq_len), dtype=bool),
+            shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+        )
+
+    def prefill(
+        self, x: jax.Array, prefix_len: int, cache: PerceiverARCache, pad_mask: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, PerceiverARCache]:
+        if prefix_len > self.max_prefix_len:
+            raise ValueError(f"prefix_len ({prefix_len}) exceeds max_prefix_len ({self.max_prefix_len})")
+        hidden, cache = self.ar.prefill(x, prefix_len=prefix_len, cache=cache, pad_mask=pad_mask)
+        return self._head(hidden), cache
+
+    def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
+        hidden, cache = self.ar.decode_step(x, cache)
+        return self._head(hidden), cache

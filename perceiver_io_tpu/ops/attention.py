@@ -1,0 +1,207 @@
+"""Multi-head attention with pad/causal masking, RoPE, and a static-shape KV cache.
+
+Behavioral parity targets (reference: /root/reference/perceiver/model/core/modules.py):
+  - ``MultiHeadAttention``  -> modules.py:23-170 (separate qk/v widths, right-aligned
+    causal masking for queries/keys of different length, pad-mask over keys, RoPE
+    applied after cache concatenation so caches hold *unrotated* keys)
+  - ``KVCache``             -> modules.py:20,117-121 (torch grows tensors; XLA cannot,
+    so here the cache is a fixed-capacity, left-aligned buffer + a scalar length)
+
+TPU-first design notes:
+  * The torch reference appends to caches by concatenation and the HF wrapper later
+    truncates them to implement a sliding window (reference core/huggingface.py:89-156).
+    Under XLA both collapse into one mechanism: a fixed-capacity buffer whose append
+    rolls the oldest entry out when full. Capacity = max_latents for self-attention
+    caches and max_seq_len for the Perceiver AR cross-attention cache reproduces the
+    reference's grow-latents -> grow-prefix -> slide policy exactly, with fully
+    static shapes.
+  * Attention logits are computed with an fp32 softmax accumulator regardless of the
+    compute dtype (bf16 on TPU), the standard numerically-safe formulation the MXU
+    supports natively.
+  * The reference's ``max_heads_parallel`` head-chunking loop (modules.py:146-166)
+    is a CUDA peak-memory workaround; under XLA attention is fused (and later
+    replaced by a Pallas flash kernel), so the field is accepted for config parity
+    but does not alter the computation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.position import apply_rope
+
+
+class KVCache(flax.struct.PyTreeNode):
+    """Fixed-capacity, left-aligned key/value cache.
+
+    ``k``: (B, capacity, num_qk_channels) unrotated projected keys
+    ``v``: (B, capacity, num_v_channels)
+    ``length``: scalar int32, number of valid (oldest-first) entries.
+
+    Append semantics: entries are written at ``length``; a single-token append to a
+    full cache first rolls the buffer left by one (dropping the oldest entry), which
+    is exactly the reference's cache-truncation sliding window.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[1]
+
+    @staticmethod
+    def create(batch_size: int, capacity: int, num_qk_channels: int, num_v_channels: int, dtype=jnp.float32) -> "KVCache":
+        return KVCache(
+            k=jnp.zeros((batch_size, capacity, num_qk_channels), dtype=dtype),
+            v=jnp.zeros((batch_size, capacity, num_v_channels), dtype=dtype),
+            length=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def append(self, k_new: jax.Array, v_new: jax.Array) -> "KVCache":
+        n_new = k_new.shape[1]
+        cap = self.capacity
+        if n_new == 1:
+            full = self.length >= cap
+            k = jnp.where(full, jnp.roll(self.k, -1, axis=1), self.k)
+            v = jnp.where(full, jnp.roll(self.v, -1, axis=1), self.v)
+            pos = jnp.minimum(self.length, cap - 1)
+            k = jax.lax.dynamic_update_slice_in_dim(k, k_new.astype(k.dtype), pos, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(v, v_new.astype(v.dtype), pos, axis=1)
+            length = jnp.minimum(self.length + 1, cap)
+        else:
+            # Multi-token (prefill) append: caller guarantees it fits.
+            k = jax.lax.dynamic_update_slice_in_dim(self.k, k_new.astype(self.k.dtype), self.length, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(self.v, v_new.astype(self.v.dtype), self.length, axis=1)
+            length = self.length + n_new
+        return KVCache(k=k, v=v, length=length)
+
+
+class MultiHeadAttention(nn.Module):
+    """Scaled dot-product multi-head attention (Perceiver IO appendix-E style).
+
+    Causal attention requires queries and keys to be right-aligned when their
+    lengths differ (reference modules.py:139-140).
+    """
+
+    num_heads: int
+    num_q_input_channels: int
+    num_kv_input_channels: int
+    num_qk_channels: Optional[int] = None
+    num_v_channels: Optional[int] = None
+    num_output_channels: Optional[int] = None
+    max_heads_parallel: Optional[int] = None  # accepted for config parity; see module docstring
+    causal_attention: bool = False
+    dropout: float = 0.0
+    qkv_bias: bool = True
+    out_bias: bool = True
+    kernel_init_scale: float = 0.02
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def _dims(self) -> Tuple[int, int, int]:
+        num_qk = self.num_qk_channels if self.num_qk_channels is not None else self.num_q_input_channels
+        num_v = self.num_v_channels if self.num_v_channels is not None else num_qk
+        num_out = self.num_output_channels if self.num_output_channels is not None else self.num_q_input_channels
+        if num_qk % self.num_heads != 0:
+            raise ValueError("num_qk_channels must be divisible by num_heads")
+        if num_v % self.num_heads != 0:
+            raise ValueError("num_v_channels must be divisible by num_heads")
+        return num_qk, num_v, num_out
+
+    def setup(self):
+        num_qk, num_v, num_out = self._dims()
+        dense = lambda feat, bias, name: nn.Dense(
+            feat,
+            use_bias=bias,
+            kernel_init=nn.initializers.normal(stddev=self.kernel_init_scale),
+            name=name,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.q_proj = dense(num_qk, self.qkv_bias, "q_proj")
+        self.k_proj = dense(num_qk, self.qkv_bias, "k_proj")
+        self.v_proj = dense(num_v, self.qkv_bias, "v_proj")
+        self.o_proj = dense(num_out, self.out_bias, "o_proj")
+        self.attn_dropout = nn.Dropout(self.dropout)
+
+    def __call__(
+        self,
+        x_q: jax.Array,
+        x_kv: jax.Array,
+        pad_mask: Optional[jax.Array] = None,
+        rope_q: Optional[jax.Array] = None,
+        rope_k: Optional[jax.Array] = None,
+        kv_cache: Optional[KVCache] = None,
+    ) -> Tuple[jax.Array, Optional[KVCache]]:
+        """Attend ``x_q`` (B, N, D) to ``x_kv`` (B, L, C).
+
+        ``pad_mask``: boolean over keys, True = padding. In cached mode its second
+        dim must equal the cache capacity (a slot-mask maintained by the caller).
+        ``rope_q`` / ``rope_k``: rotary phase angles, one row per query / key row
+        ((B, N, r) / (B, n_k, r)); callers do any right-alignment slicing.
+        Returns (output (B, N, F), updated cache or None).
+        """
+        num_qk, num_v, _ = self._dims()
+        num_qk_per_head = num_qk // self.num_heads
+        scale = num_qk_per_head**-0.5
+
+        q = self.q_proj(x_q)
+        k = self.k_proj(x_kv)
+        v = self.v_proj(x_kv)
+
+        if kv_cache is not None:
+            kv_cache = kv_cache.append(k, v)
+            k, v = kv_cache.k, kv_cache.v  # full capacity buffers
+
+        b, n_q = q.shape[0], q.shape[1]
+        n_k = k.shape[1]
+
+        split = lambda t: t.reshape(b, t.shape[1], self.num_heads, -1).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        q = q * scale
+
+        if rope_q is not None:
+            q = apply_rope(q, rope_q)
+        if rope_k is not None:
+            k = apply_rope(k, rope_k)
+
+        # fp32 logits + softmax for numerical stability in bf16 compute
+        attn = jnp.einsum("bhic,bhjc->bhij", q, k, preferred_element_type=jnp.float32)
+        neg = jnp.finfo(attn.dtype).min
+
+        if pad_mask is not None:
+            attn = jnp.where(pad_mask[:, None, None, :], neg, attn)
+
+        if self.causal_attention:
+            if kv_cache is None:
+                # Right-aligned causal mask: query row i may see key cols 0..(n_k - n_q + i).
+                causal = jnp.triu(jnp.ones((n_q, n_k), dtype=bool), k=n_k - n_q + 1)
+                attn = jnp.where(causal[None, None, :, :], neg, attn)
+            else:
+                # Cached mode: key slot j holds sequence position j (left-aligned
+                # buffer); query row i has absolute position length - n_q + i.
+                q_pos = kv_cache.length - n_q + jnp.arange(n_q)
+                visible = jnp.arange(n_k)[None, :] <= q_pos[:, None]
+                attn = jnp.where(visible[None, None, :, :], attn, neg)
+        elif kv_cache is not None:
+            valid = jnp.arange(n_k) < kv_cache.length
+            attn = jnp.where(valid[None, None, None, :], attn, neg)
+
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = self.attn_dropout(attn, deterministic=self.deterministic)
+        attn = attn.astype(v.dtype)
+
+        o = jnp.einsum("bhij,bhjc->bhic", attn, v)
+        # o's batch may exceed x_q's when a (1, N, D) query broadcast against a
+        # batched key/value input, so recover the batch size from o itself.
+        o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
+        o = self.o_proj(o)
+        return o, kv_cache

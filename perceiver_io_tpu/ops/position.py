@@ -1,0 +1,139 @@
+"""Position encodings: absolute positions, rotary (RoPE), frequency, and Fourier features.
+
+Behavioral parity targets (reference: /root/reference/perceiver/model/core/position.py):
+  - ``positions``            -> position.py:9-17  (left-pad shift + clamp at 0)
+  - ``RotaryPositionEmbedding`` -> position.py:20-50 (rotate-half formulation,
+    right-align option used by Perceiver AR where queries/keys are right-aligned)
+  - ``FrequencyPositionEncoding`` -> position.py:53-71 (inv freq outer product,
+    each frequency repeated twice along the channel dim)
+  - ``FourierPositionEncoding`` -> position.py:74-138 (linspace coords in [-1,1]
+    per spatial dim, sin/cos over bands linearly spaced to Nyquist)
+
+TPU-first design notes: everything here is pure jnp on static shapes, traced once
+under jit. The Fourier encoding table for images is precomputed at model-build
+time with numpy (host) and closed over as a constant, so XLA folds it into the
+compiled program instead of recomputing per step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def positions(b: int, n: int, shift: Optional[jax.Array] = None) -> jax.Array:
+    """Absolute position ids of shape (b, n), optionally shifted left by a per-example
+    pad count (callers must left-pad) and clamped at 0."""
+    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (b, n))
+    if shift is not None:
+        if shift.shape != (b, 1):
+            raise ValueError(f"shift must have shape {(b, 1)} but has shape {shift.shape}")
+        pos = pos - shift.astype(jnp.int32)
+    return jnp.maximum(pos, 0)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    """Channel pairs [x1, x2, x3, x4, ...] -> [-x2, x1, -x4, x3, ...]."""
+    x = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1, x2 = x[..., 0], x[..., 1]
+    x = jnp.stack((-x2, x1), axis=-1)
+    return x.reshape(*x.shape[:-2], -1)
+
+
+def apply_rope(t: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate the first ``angles.shape[-1]`` channels of ``t`` (b, h, n, c) by the
+    per-position phase ``angles`` (b, n, r); remaining channels pass through.
+
+    Rotation by a zero angle is the identity, so callers can gate rotary layers by
+    multiplying ``angles`` with a 0/1 flag — branch-free under ``lax.scan``.
+    """
+    r = angles.shape[-1]
+    pos_enc = angles[:, None, :, :].astype(t.dtype)  # (b, 1, n, r)
+    t_rot, t_pass = t[..., :r], t[..., r:]
+    t_rot = t_rot * jnp.cos(pos_enc) + rotate_half(t_rot) * jnp.sin(pos_enc)
+    if t_pass.shape[-1] == 0:
+        return t_rot
+    return jnp.concatenate((t_rot, t_pass), axis=-1)
+
+
+class RotaryPositionEmbedding:
+    """Rotary position embedding (https://arxiv.org/abs/2104.09864).
+
+    Holds a frequency position encoding of shape (b, n, r) and rotates the first
+    ``r`` channels of a (b, h, seq, c) tensor. When ``right_align`` is set the
+    *last* ``seq`` rows of the encoding are used (Perceiver AR right-aligns
+    queries and keys of different length).
+
+    This is a plain Python value class over traced arrays — safe to construct
+    inside jit.
+    """
+
+    def __init__(self, frq_pos_enc: jax.Array, right_align: bool = False):
+        self.frq_pos_enc = frq_pos_enc[:, None, :, :]  # (b, 1, n, r)
+        self.rotate_dim = frq_pos_enc.shape[-1]
+        self.right_align = right_align
+
+    def rotate(self, t: jax.Array) -> jax.Array:
+        seq_len = t.shape[-2]
+        if self.right_align:
+            pos_enc = self.frq_pos_enc[..., -seq_len:, :]
+        else:
+            pos_enc = self.frq_pos_enc[..., :seq_len, :]
+
+        pos_enc = pos_enc.astype(t.dtype)
+        t_rot, t_pass = t[..., : self.rotate_dim], t[..., self.rotate_dim :]
+        t_rot = t_rot * jnp.cos(pos_enc) + rotate_half(t_rot) * jnp.sin(pos_enc)
+        return jnp.concatenate((t_rot, t_pass), axis=-1)
+
+
+def frequency_position_encoding(abs_pos: jax.Array, dim: int) -> jax.Array:
+    """Encode integer positions (b, n) as rotary phase angles (b, n, dim).
+
+    ``inv_freq_i = 10000 ** (-2(i-1)/dim)``; each frequency appears twice in
+    adjacent channels so that channel pairs share a rotation angle.
+    """
+    inv_freq = 1.0 / (10000 ** (np.arange(0, dim, 2, dtype=np.float32) / dim))
+    pos_enc = abs_pos.astype(jnp.float32)[..., None] * jnp.asarray(inv_freq)  # (b, n, dim//2)
+    return jnp.repeat(pos_enc, 2, axis=-1)
+
+
+def fourier_position_encodings(
+    input_shape: Sequence[int],
+    num_frequency_bands: int,
+    include_positions: bool = True,
+) -> np.ndarray:
+    """Fourier feature table for an n-d grid, flattened over spatial dims.
+
+    Returns a numpy array of shape (prod(input_shape), C) with
+    C = len(input_shape) * (2 * num_frequency_bands + include_positions).
+    Computed on host once; callers embed it as a constant.
+    """
+    coords = [np.linspace(-1.0, 1.0, num=s, dtype=np.float32) for s in input_shape]
+    pos = np.stack(np.meshgrid(*coords, indexing="ij"), axis=-1)  # (*shape, d)
+
+    encodings = []
+    if include_positions:
+        encodings.append(pos)
+
+    # per-dim frequencies linearly spaced from 1 to Nyquist (= s/2)
+    sin_parts, cos_parts = [], []
+    for i, s in enumerate(input_shape):
+        freqs = np.linspace(1.0, s / 2.0, num=num_frequency_bands, dtype=np.float32)
+        grid = pos[..., i : i + 1] * freqs[None, :]  # (*shape, bands)
+        sin_parts.append(np.sin(math.pi * grid))
+        cos_parts.append(np.cos(math.pi * grid))
+
+    encodings.extend(sin_parts)
+    encodings.extend(cos_parts)
+    enc = np.concatenate(encodings, axis=-1)
+    return enc.reshape(-1, enc.shape[-1])
+
+
+def num_fourier_channels(
+    input_shape: Sequence[int], num_frequency_bands: int, include_positions: bool = True
+) -> int:
+    return len(input_shape) * (2 * num_frequency_bands + int(include_positions))

@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths are
+exercised without TPU hardware (the reference could not test its NCCL paths in CI
+at all — see SURVEY.md §4). A persistent compilation cache keeps re-runs fast.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Plugins may force their own platform via jax.config at interpreter start
+# (overriding JAX_PLATFORMS env); the config update below wins over both.
+jax.config.update("jax_platforms", "cpu")
+
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def x64():
+    """Enable float64 for strict (bitwise / 1e-12) equivalence tests."""
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)

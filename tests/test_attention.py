@@ -1,0 +1,139 @@
+"""MultiHeadAttention unit tests vs a NumPy reference implementation
+(reference semantics: perceiver/model/core/modules.py:23-170)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.ops.attention import KVCache, MultiHeadAttention
+
+
+def numpy_attention(params, x_q, x_kv, num_heads, causal=False, pad_mask=None):
+    """Straightforward NumPy reimplementation of scaled dot-product attention with
+    the reference's right-aligned causal mask."""
+    p = jax.tree.map(np.asarray, params["params"])
+    proj = lambda x, name: x @ p[name]["kernel"] + p[name]["bias"]
+    q, k, v = proj(x_q, "q_proj"), proj(x_kv, "k_proj"), proj(x_kv, "v_proj")
+    b, nq, _ = q.shape
+    nk = k.shape[1]
+    h = num_heads
+    split = lambda t: t.reshape(b, t.shape[1], h, -1).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    q = q * (q.shape[-1] ** -0.5)
+    logits = np.einsum("bhic,bhjc->bhij", q, k)
+    if pad_mask is not None:
+        logits = np.where(pad_mask[:, None, None, :], -np.inf, logits)
+    if causal:
+        mask = np.triu(np.ones((nq, nk), bool), k=nk - nq + 1)
+        logits = np.where(mask[None, None], -np.inf, logits)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    o = np.einsum("bhij,bhjc->bhic", w, v)
+    o = o.transpose(0, 2, 1, 3).reshape(b, nq, -1)
+    return o @ p["o_proj"]["kernel"] + p["o_proj"]["bias"]
+
+
+@pytest.fixture(scope="module")
+def mha_setup():
+    mha = MultiHeadAttention(num_heads=2, num_q_input_channels=8, num_kv_input_channels=6)
+    rng = jax.random.PRNGKey(0)
+    x_q = jax.random.normal(rng, (2, 4, 8))
+    x_kv = jax.random.normal(jax.random.PRNGKey(1), (2, 7, 6))
+    params = mha.init(rng, x_q, x_kv)
+    return mha, params, x_q, x_kv
+
+
+def test_cross_attention_matches_numpy(mha_setup):
+    mha, params, x_q, x_kv = mha_setup
+    out, _ = mha.apply(params, x_q, x_kv)
+    expected = numpy_attention(params, np.asarray(x_q), np.asarray(x_kv), num_heads=2)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_pad_mask(mha_setup):
+    mha, params, x_q, x_kv = mha_setup
+    pad = np.zeros((2, 7), bool)
+    pad[0, -2:] = True
+    out, _ = mha.apply(params, x_q, x_kv, pad_mask=jnp.asarray(pad))
+    expected = numpy_attention(params, np.asarray(x_q), np.asarray(x_kv), num_heads=2, pad_mask=pad)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+    # masked keys must not influence the output: perturb them, output unchanged
+    x_kv2 = np.asarray(x_kv).copy()
+    x_kv2[0, -2:] += 100.0
+    out2, _ = mha.apply(params, jnp.asarray(x_q), jnp.asarray(x_kv2), pad_mask=jnp.asarray(pad))
+    np.testing.assert_allclose(out[0], out2[0], atol=1e-4)
+
+
+def test_causal_right_aligned():
+    mha = MultiHeadAttention(
+        num_heads=2, num_q_input_channels=8, num_kv_input_channels=8, causal_attention=True
+    )
+    rng = jax.random.PRNGKey(0)
+    x_q = jax.random.normal(rng, (1, 3, 8))
+    x_kv = jax.random.normal(jax.random.PRNGKey(1), (1, 5, 8))
+    params = mha.init(rng, x_q, x_kv)
+    out, _ = mha.apply(params, x_q, x_kv)
+    expected = numpy_attention(params, np.asarray(x_q), np.asarray(x_kv), num_heads=2, causal=True)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+    # future keys (beyond the right-aligned diagonal) must not affect outputs
+    x_kv2 = np.asarray(x_kv).copy()
+    x_kv2[0, -1] += 100.0  # visible only to the last query
+    out2, _ = mha.apply(params, x_q, jnp.asarray(x_kv2))
+    np.testing.assert_allclose(out[0, :2], out2[0, :2], atol=1e-4)
+    assert not np.allclose(out[0, 2], out2[0, 2], atol=1e-2)
+
+
+def test_qk_v_widths():
+    mha = MultiHeadAttention(
+        num_heads=2,
+        num_q_input_channels=8,
+        num_kv_input_channels=6,
+        num_qk_channels=4,
+        num_v_channels=10,
+        num_output_channels=12,
+    )
+    rng = jax.random.PRNGKey(0)
+    x_q = jax.random.normal(rng, (2, 3, 8))
+    x_kv = jax.random.normal(rng, (2, 5, 6))
+    params = mha.init(rng, x_q, x_kv)
+    out, _ = mha.apply(params, x_q, x_kv)
+    assert out.shape == (2, 3, 12)
+
+
+def test_indivisible_heads_raise():
+    mha = MultiHeadAttention(num_heads=3, num_q_input_channels=8, num_kv_input_channels=8)
+    with pytest.raises(ValueError, match="num_qk_channels must be divisible by num_heads"):
+        mha.init(jax.random.PRNGKey(0), jnp.zeros((1, 2, 8)), jnp.zeros((1, 2, 8)))
+
+
+def test_kv_cache_append_and_roll():
+    cache = KVCache.create(2, capacity=3, num_qk_channels=4, num_v_channels=4)
+    k1 = jnp.ones((2, 2, 4))
+    cache = cache.append(k1, k1)
+    assert int(cache.length) == 2
+    np.testing.assert_allclose(cache.k[:, :2], 1.0)
+    cache = cache.append(2 * jnp.ones((2, 1, 4)), 2 * jnp.ones((2, 1, 4)))
+    assert int(cache.length) == 3
+    # full: next single-token append rolls the oldest entry out
+    cache = cache.append(3 * jnp.ones((2, 1, 4)), 3 * jnp.ones((2, 1, 4)))
+    assert int(cache.length) == 3
+    np.testing.assert_allclose(cache.k[0, :, 0], [1.0, 2.0, 3.0])
+
+
+def test_cached_causal_equivalence():
+    """Single-token cached decode == full uncached causal self-attention rows."""
+    mha = MultiHeadAttention(
+        num_heads=2, num_q_input_channels=8, num_kv_input_channels=8, causal_attention=True
+    )
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 6, 8))
+    params = mha.init(rng, x, x)
+    full, _ = mha.apply(params, x, x)
+
+    cache = KVCache.create(2, capacity=6, num_qk_channels=8, num_v_channels=8)
+    out_p, cache = mha.apply(params, x[:, :3], x[:, :3], kv_cache=cache)
+    np.testing.assert_allclose(out_p, full[:, :3], atol=1e-5)
+    for t in range(3, 6):
+        out_t, cache = mha.apply(params, x[:, t : t + 1], x[:, t : t + 1], kv_cache=cache)
+        np.testing.assert_allclose(out_t[:, 0], full[:, t], atol=1e-5)

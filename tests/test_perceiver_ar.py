@@ -1,0 +1,146 @@
+"""Perceiver AR correctness tests, mirroring the reference's KV-cache equivalence
+pillars (reference tests/kv_cache_test.py:82-235): cached decode must equal the
+uncached forward. Strict comparisons run in float64 where the equality is exact;
+float32 comparisons allow for XLA reduction-order noise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+VOCAB = 64
+
+
+def make_model(deterministic=True, dtype=jnp.float32, **kwargs):
+    defaults = dict(
+        vocab_size=VOCAB,
+        max_seq_len=16,
+        max_latents=8,
+        num_channels=16,
+        num_heads=2,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.0,
+        output_norm=True,
+    )
+    defaults.update(kwargs)
+    cfg = CausalSequenceModelConfig(**defaults)
+    return CausalSequenceModel(config=cfg, deterministic=deterministic, param_dtype=dtype)
+
+
+@pytest.fixture(scope="module")
+def setup(x64):
+    model = make_model(dtype=jnp.float64)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (2, 16), 0, VOCAB)
+    params = jax.jit(model.init, static_argnames="prefix_len")(rng, x[:, :8], prefix_len=4)
+    return model, params, x
+
+
+def test_logit_shapes(setup):
+    model, params, x = setup
+    logits = model.apply(params, x[:, :10], prefix_len=4)
+    assert logits.shape == (2, 6, VOCAB)
+
+
+def test_prefix_len_validation(setup):
+    model, params, x = setup
+    with pytest.raises(ValueError, match=r"prefix_len \(8\) out of valid range"):
+        model.apply(params, x[:, :8], prefix_len=8)
+    with pytest.raises(ValueError, match=r"prefix_len \(9\) exceeds max_prefix_len \(8\)"):
+        model.apply(params, x[:, :12], prefix_len=9)
+
+
+def test_prefill_equals_uncached(setup):
+    model, params, x = setup
+    full = model.apply(params, x[:, :8], prefix_len=4)
+    cache = model.init_cache(batch_size=2, dtype=jnp.float64)
+    pf, cache = model.apply(params, x[:, :8], 4, cache, method=CausalSequenceModel.prefill)
+    np.testing.assert_array_equal(np.asarray(pf), np.asarray(full))
+    assert int(cache.ca.length) == 8
+    assert int(cache.sa.length[0]) == 4
+
+
+def test_decode_equals_uncached_growth_regime(setup):
+    """Latents grow from 4 to max_latents=8 while the prefix stays fixed — the
+    regime where cached and uncached forwards are mathematically identical
+    (reference kv_cache_test comparisons)."""
+    model, params, x = setup
+    cache = model.init_cache(batch_size=2, dtype=jnp.float64)
+    _, cache = model.apply(params, x[:, :8], 4, cache, method=CausalSequenceModel.prefill)
+    for t in range(8, 12):
+        step, cache = model.apply(params, x[:, t : t + 1], cache, method=CausalSequenceModel.decode_step)
+        full = model.apply(params, x[:, : t + 1], prefix_len=4)
+        np.testing.assert_allclose(np.asarray(step[:, -1]), np.asarray(full[:, -1]), atol=1e-12)
+
+
+def test_decode_equals_uncached_left_padded(setup):
+    model, params, x = setup
+    pad = jnp.zeros((2, 8), bool).at[0, :3].set(True)
+    xp = jnp.where(pad, 0, x[:, :8])
+    cache = model.init_cache(batch_size=2, dtype=jnp.float64)
+    _, cache = model.apply(params, xp, 4, cache, pad_mask=pad, method=CausalSequenceModel.prefill)
+    for t in range(8, 12):
+        step, cache = model.apply(params, x[:, t : t + 1], cache, method=CausalSequenceModel.decode_step)
+        xn = jnp.concatenate([xp, x[:, 8 : t + 1]], axis=1)
+        padn = jnp.concatenate([pad, jnp.zeros((2, t + 1 - 8), bool)], axis=1)
+        full = model.apply(params, xn, prefix_len=4, pad_mask=padn)
+        np.testing.assert_allclose(np.asarray(step[:, -1]), np.asarray(full[:, -1]), atol=1e-12)
+
+
+def test_sliding_window_rolls_caches(setup):
+    """Beyond max_seq_len the window slides: cache lengths stay pinned at capacity
+    and decoding continues without error (no uncached ground truth exists here —
+    same as the reference's HF cache-truncation path, core/huggingface.py:140-156)."""
+    model, params, x = setup
+    cache = model.init_cache(batch_size=2, dtype=jnp.float64)
+    _, cache = model.apply(params, x, 8, cache, method=CausalSequenceModel.prefill)  # fills to 16/16
+    assert int(cache.ca.length) == 16 and int(cache.sa.length[0]) == 8
+    tok = x[:, :1]
+    old_k = np.asarray(cache.ca.k)
+    logits, cache = model.apply(params, tok, cache, method=CausalSequenceModel.decode_step)
+    assert int(cache.ca.length) == 16 and int(cache.sa.length[0]) == 8
+    assert logits.shape == (2, 1, VOCAB)
+    np.testing.assert_array_equal(np.asarray(cache.ca.k[:, :-1]), old_k[:, 1:])  # rolled left
+
+
+def test_prefix_dropout_statistics():
+    """Training-time prefix dropout keeps exactly prefix_len - int(prefix_len * p)
+    positions (reference modules.py:814-821); with p=0.5 outputs must differ across
+    rng draws but shapes stay static."""
+    model = make_model(deterministic=False, cross_attention_dropout=0.5)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (2, 16), 0, VOCAB)
+    params = model.init({"params": rng, "dropout": jax.random.PRNGKey(1)}, x, prefix_len=8)
+    out1 = model.apply(params, x, prefix_len=8, rngs={"dropout": jax.random.PRNGKey(2)})
+    out2 = model.apply(params, x, prefix_len=8, rngs={"dropout": jax.random.PRNGKey(3)})
+    assert out1.shape == (2, 8, VOCAB)
+    assert not np.allclose(out1, out2, atol=1e-4)
+    # deterministic instance ignores prefix dropout entirely
+    det = make_model(deterministic=True, cross_attention_dropout=0.5)
+    out3 = det.apply(params, x, prefix_len=8)
+    out4 = det.apply(params, x, prefix_len=8)
+    np.testing.assert_array_equal(np.asarray(out3), np.asarray(out4))
+
+
+def test_prefill_rejects_nondeterministic():
+    model = make_model(deterministic=False, cross_attention_dropout=0.5)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (2, 8), 0, VOCAB)
+    params = model.init({"params": rng, "dropout": rng}, x, prefix_len=4)
+    cache = model.init_cache(batch_size=2)
+    with pytest.raises(ValueError, match="cross-attention dropout not supported with caching"):
+        model.apply(params, x, 4, cache, rngs={"dropout": rng}, method=CausalSequenceModel.prefill)
+
+
+def test_tied_embedding_head():
+    """Output head must be tied to the input embedding: no separate vocab x channels
+    output matrix in the param tree."""
+    model = make_model(output_bias=False, vocab_size=59)  # prime: no shape collisions
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=4)
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    vocab_matrices = [p for p, v in flat if v.shape == (59, 16)]
+    assert len(vocab_matrices) == 1  # just the shared embedding

@@ -1,0 +1,97 @@
+"""Position-encoding unit tests (reference semantics: perceiver/model/core/position.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.ops.position import (
+    RotaryPositionEmbedding,
+    apply_rope,
+    fourier_position_encodings,
+    frequency_position_encoding,
+    num_fourier_channels,
+    positions,
+    rotate_half,
+)
+
+
+def test_positions_basic():
+    pos = positions(2, 5)
+    np.testing.assert_array_equal(pos, [[0, 1, 2, 3, 4]] * 2)
+
+
+def test_positions_shift_clamp():
+    shift = jnp.array([[2], [0]])
+    pos = positions(2, 5, shift=shift)
+    np.testing.assert_array_equal(pos[0], [0, 0, 0, 1, 2])
+    np.testing.assert_array_equal(pos[1], [0, 1, 2, 3, 4])
+
+
+def test_positions_shift_shape_validation():
+    with pytest.raises(ValueError, match="shift must have shape"):
+        positions(2, 5, shift=jnp.zeros((2,), jnp.int32))
+
+
+def test_rotate_half():
+    x = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(rotate_half(x), [[-2.0, 1.0, -4.0, 3.0]])
+
+
+def test_frequency_position_encoding_values():
+    # inv_freq_i = 10000^(-2(i-1)/dim), each repeated twice
+    abs_pos = jnp.asarray([[0, 1, 2]])
+    enc = frequency_position_encoding(abs_pos, dim=4)
+    assert enc.shape == (1, 3, 4)
+    inv = np.array([1.0, 10000 ** (-2 / 4)])
+    expected = np.stack([p * np.repeat(inv, 2) for p in [0, 1, 2]])
+    np.testing.assert_allclose(enc[0], expected, rtol=1e-6)
+
+
+def test_apply_rope_identity_at_zero_angle():
+    t = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 5, 8))
+    angles = jnp.zeros((2, 5, 4))
+    np.testing.assert_allclose(apply_rope(t, angles), t)
+
+
+def test_apply_rope_partial_rotation_passthrough():
+    t = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 4, 8))
+    angles = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 4))
+    out = apply_rope(t, angles)
+    np.testing.assert_allclose(out[..., 4:], t[..., 4:])  # unrotated channels pass through
+    assert not np.allclose(out[..., :4], t[..., :4])
+
+
+def test_apply_rope_preserves_norm():
+    # rotation is unitary on channel pairs; pairs share an angle (as produced by
+    # frequency_position_encoding's pairwise repeat)
+    t = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 6, 4))
+    angles = jnp.repeat(jax.random.normal(jax.random.PRNGKey(1), (1, 6, 2)), 2, axis=-1)
+    out = apply_rope(t, angles)
+    np.testing.assert_allclose(jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(t, axis=-1), rtol=1e-5)
+
+
+def test_rotary_right_align():
+    # right_align uses the LAST seq_len rows of the encoding (Perceiver AR)
+    angles = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 4))
+    t = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 3, 4))
+    right = RotaryPositionEmbedding(angles, right_align=True).rotate(t)
+    manual = apply_rope(t, angles[:, -3:])
+    np.testing.assert_allclose(right, manual, rtol=1e-6)
+    left = RotaryPositionEmbedding(angles, right_align=False).rotate(t)
+    manual_left = apply_rope(t, angles[:, :3])
+    np.testing.assert_allclose(left, manual_left, rtol=1e-6)
+
+
+def test_fourier_position_encoding_shape_and_range():
+    enc = fourier_position_encodings((4, 6), num_frequency_bands=3)
+    assert enc.shape == (24, num_fourier_channels((4, 6), 3))
+    assert enc.shape[1] == 2 * (2 * 3 + 1)
+    # first two channels are the raw coordinates in [-1, 1]
+    assert enc[:, 0].min() == -1.0 and enc[:, 0].max() == 1.0
+    assert np.abs(enc[:, 2:]).max() <= 1.0 + 1e-6
+
+
+def test_fourier_position_encoding_sequence():
+    enc = fourier_position_encodings((5,), num_frequency_bands=2, include_positions=False)
+    assert enc.shape == (5, 4)
