@@ -1,0 +1,83 @@
+"""Benchmark: Perceiver AR causal-LM training throughput on one TPU chip.
+
+Runs the flagship 30.7M-param configuration (the reference's WikiText-103 CLM,
+docs/training-examples.md:160-162: max_seq_len=4096, max_latents=512, vocab=262)
+as a jitted bf16 train step and prints ONE JSON line:
+
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": MFU/0.40}
+
+vs_baseline is measured MFU against the BASELINE.json north star of 40% MFU
+(the reference publishes no throughput numbers to compare against directly).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+    from perceiver_io_tpu.training.flops import PerceiverARFlops, detect_peak_flops, mfu
+    from perceiver_io_tpu.training.trainer import TrainState, build_optimizer, make_causal_lm_train_step
+
+    config = CausalSequenceModelConfig(
+        vocab_size=262,
+        max_seq_len=4096,
+        max_latents=512,
+        num_channels=512,
+        num_heads=8,
+        num_self_attention_layers=8,
+        cross_attention_dropout=0.5,
+    )
+    batch_size = 8
+    model = CausalSequenceModel(config=config, deterministic=False, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (batch_size, config.max_seq_len), 0, config.vocab_size)
+    batch = {"input_ids": x, "labels": jnp.roll(x, -1, axis=1)}
+
+    prefix_len = config.max_seq_len - config.max_latents
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        {"params": rng, "dropout": rng}, x, prefix_len=prefix_len
+    )
+    tx = build_optimizer(1e-3, max_grad_norm=1.0)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=config.max_latents), donate_argnums=(0,))
+
+    # warmup / compile. NOTE: synchronize via a host fetch of the loss — through
+    # remote-execution tunnels (axon) block_until_ready can return before the
+    # device work completes, but a device->host transfer cannot.
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # steps are state-dependent: this waits for all of them
+    dt = time.perf_counter() - t0
+
+    flops_model = PerceiverARFlops(config=config, seq_len=config.max_seq_len, prefix_dropout=config.cross_attention_dropout)
+    tokens_per_sec = flops_model.tokens_per_step(batch_size) * n_steps / dt
+    measured_mfu = mfu(tokens_per_sec, flops_model, batch_size, detect_peak_flops())
+
+    print(
+        json.dumps(
+            {
+                "metric": "perceiver_ar_clm_30m_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "latent_tokens/s",
+                "vs_baseline": round(measured_mfu / 0.40, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

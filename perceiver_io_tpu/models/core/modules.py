@@ -347,12 +347,10 @@ class SelfAttentionBlock(nn.Module):
         return self.num_v_channels if self.num_v_channels is not None else self.resolved_num_qk_channels
 
     def empty_kv_cache(self, batch_size: int, capacity: int, dtype=jnp.float32) -> KVCache:
-        """Stacked per-layer cache with leading (num_layers,) axis, consumed/produced
-        one slice per scan iteration."""
-        return KVCache(
-            k=jnp.zeros((self.num_layers, batch_size, capacity, self.resolved_num_qk_channels), dtype),
-            v=jnp.zeros((self.num_layers, batch_size, capacity, self.resolved_num_v_channels), dtype),
-            length=jnp.zeros((self.num_layers,), jnp.int32),
+        """Stacked per-layer cache (reference per-layer empty_kv_cache factory,
+        modules.py:282-285). Built from constructor fields only — usable unbound."""
+        return KVCache.create_stacked(
+            self.num_layers, batch_size, capacity, self.resolved_num_qk_channels, self.resolved_num_v_channels, dtype
         )
 
     @nn.compact

@@ -69,6 +69,19 @@ class PerceiverARCache(flax.struct.PyTreeNode):
         return self.ca.length
 
 
+def _make_ar_cache(
+    batch_size: int, max_seq_len: int, max_latents: int, num_layers: int, num_channels: int, dtype=jnp.float32
+) -> PerceiverARCache:
+    """Single construction point for the Perceiver AR decode state (the capacities
+    encode the reference's sliding-window policy — see module docstring)."""
+    return PerceiverARCache(
+        ca=KVCache.create(batch_size, max_seq_len, num_channels, num_channels, dtype),
+        sa=KVCache.create_stacked(num_layers, batch_size, max_latents, num_channels, num_channels, dtype),
+        pad_slots=jnp.zeros((batch_size, max_seq_len), dtype=bool),
+        shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+    )
+
+
 class PerceiverAR(nn.Module):
     """Generic Perceiver AR over an input adapter with rotary support."""
 
@@ -184,16 +197,8 @@ class PerceiverAR(nn.Module):
         # Built from constructor fields only, so it works on an unbound module
         # (no params or setup state involved).
         num_channels = self.input_adapter.num_input_channels
-        num_layers = self.num_self_attention_layers
-        return PerceiverARCache(
-            ca=KVCache.create(batch_size, max_seq_len, num_channels, num_channels, dtype),
-            sa=KVCache(
-                k=jnp.zeros((num_layers, batch_size, max_latents, num_channels), dtype),
-                v=jnp.zeros((num_layers, batch_size, max_latents, num_channels), dtype),
-                length=jnp.zeros((num_layers,), jnp.int32),
-            ),
-            pad_slots=jnp.zeros((batch_size, max_seq_len), dtype=bool),
-            shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+        return _make_ar_cache(
+            batch_size, max_seq_len, max_latents, self.num_self_attention_layers, num_channels, dtype
         )
 
     def _rotated_dim(self) -> int:
@@ -206,9 +211,11 @@ class PerceiverAR(nn.Module):
         cache: PerceiverARCache,
         pad_mask: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, PerceiverARCache]:
-        """Process a full prompt (B, N) into empty caches; N - prefix_len latents.
-        Prefix dropout must be off (deterministic instance) — reference raises the
-        same way for cache + dropout (modules.py:810-812)."""
+        """Process a full prompt (B, N) into caches; N - prefix_len latents.
+        The given cache is structurally RESET first (prefill defines the window
+        from scratch), so passing a used cache cannot corrupt state. Prefix
+        dropout must be off (deterministic instance) — reference raises the same
+        way for cache + dropout (modules.py:810-812)."""
         if not self.deterministic:
             raise ValueError("cross-attention dropout not supported with caching")
         b, n = x.shape
@@ -218,6 +225,7 @@ class PerceiverAR(nn.Module):
             raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
         if n > ca_cap or (n - prefix_len) > sa_cap:
             raise ValueError("prompt does not fit cache capacities")
+        cache = cache.replace(ca=cache.ca.reset(), sa=cache.sa.reset())
 
         shift = (
             jnp.zeros((b, 1), jnp.int32) if pad_mask is None else jnp.sum(pad_mask, axis=1, keepdims=True).astype(jnp.int32)
@@ -232,9 +240,9 @@ class PerceiverAR(nn.Module):
         slot_pos = jnp.maximum(jnp.arange(ca_cap)[None, :] - shift, 0)
         rope_k_ca = frequency_position_encoding(slot_pos, self._rotated_dim())
 
-        pad_slots = cache.pad_slots
+        pad_slots = jnp.zeros((b, ca_cap), dtype=bool)
         if pad_mask is not None:
-            pad_slots = jnp.zeros((b, ca_cap), dtype=bool).at[:, :n].set(pad_mask)
+            pad_slots = pad_slots.at[:, :n].set(pad_mask)
 
         x_latent, ca_cache = self.cross_attention(
             x_latent,
@@ -372,15 +380,8 @@ class CausalSequenceModel(nn.Module):
     def init_cache(self, batch_size: int, dtype=jnp.float32) -> PerceiverARCache:
         # Built from config only, so it works on an unbound module.
         cfg = self.config
-        return PerceiverARCache(
-            ca=KVCache.create(batch_size, cfg.max_seq_len, cfg.num_channels, cfg.num_channels, dtype),
-            sa=KVCache(
-                k=jnp.zeros((cfg.num_self_attention_layers, batch_size, cfg.max_latents, cfg.num_channels), dtype),
-                v=jnp.zeros((cfg.num_self_attention_layers, batch_size, cfg.max_latents, cfg.num_channels), dtype),
-                length=jnp.zeros((cfg.num_self_attention_layers,), jnp.int32),
-            ),
-            pad_slots=jnp.zeros((batch_size, cfg.max_seq_len), dtype=bool),
-            shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+        return _make_ar_cache(
+            batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers, cfg.num_channels, dtype
         )
 
     def prefill(

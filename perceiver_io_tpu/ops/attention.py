@@ -64,6 +64,23 @@ class KVCache(flax.struct.PyTreeNode):
             length=jnp.zeros((), dtype=jnp.int32),
         )
 
+    @staticmethod
+    def create_stacked(
+        num_layers: int, batch_size: int, capacity: int, num_qk_channels: int, num_v_channels: int, dtype=jnp.float32
+    ) -> "KVCache":
+        """Per-layer caches stacked on a leading layer axis, consumed/produced one
+        slice per ``nn.scan`` iteration (see SelfAttentionBlock)."""
+        return KVCache(
+            k=jnp.zeros((num_layers, batch_size, capacity, num_qk_channels), dtype=dtype),
+            v=jnp.zeros((num_layers, batch_size, capacity, num_v_channels), dtype=dtype),
+            length=jnp.zeros((num_layers,), dtype=jnp.int32),
+        )
+
+    def reset(self) -> "KVCache":
+        """Empty the cache (length -> 0) without reallocating buffers; stale slot
+        contents are unreachable behind the causal/validity masks."""
+        return self.replace(length=jnp.zeros_like(self.length))
+
     def append(self, k_new: jax.Array, v_new: jax.Array) -> "KVCache":
         n_new = k_new.shape[1]
         cap = self.capacity

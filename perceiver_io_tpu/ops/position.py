@@ -80,14 +80,10 @@ class RotaryPositionEmbedding:
     def rotate(self, t: jax.Array) -> jax.Array:
         seq_len = t.shape[-2]
         if self.right_align:
-            pos_enc = self.frq_pos_enc[..., -seq_len:, :]
+            angles = self.frq_pos_enc[:, 0, -seq_len:, :]
         else:
-            pos_enc = self.frq_pos_enc[..., :seq_len, :]
-
-        pos_enc = pos_enc.astype(t.dtype)
-        t_rot, t_pass = t[..., : self.rotate_dim], t[..., self.rotate_dim :]
-        t_rot = t_rot * jnp.cos(pos_enc) + rotate_half(t_rot) * jnp.sin(pos_enc)
-        return jnp.concatenate((t_rot, t_pass), axis=-1)
+            angles = self.frq_pos_enc[:, 0, :seq_len, :]
+        return apply_rope(t, angles)
 
 
 def frequency_position_encoding(abs_pos: jax.Array, dim: int) -> jax.Array:

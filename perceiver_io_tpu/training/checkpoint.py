@@ -1,0 +1,39 @@
+"""Orbax checkpointing — the replacement for Lightning's ModelCheckpoint and the
+``params=<ckpt or HF repo>`` warm-start dispatch (reference core/lightning.py:145-147,
+SURVEY.md §5 checkpoint/resume).
+
+Checkpoints are sharding-aware: restoring under a mesh places shards directly on
+their devices (no host round-trip), which Lightning/FSDP could not do.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _checkpointer() -> ocp.StandardCheckpointer:
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
+    path = os.path.abspath(os.fspath(path))
+    ckpt = _checkpointer()
+    ckpt.save(path, state, force=force)
+    ckpt.wait_until_finished()  # StandardCheckpointer saves asynchronously
+
+
+def restore_checkpoint(path: str, template: Any, shardings: Optional[Any] = None) -> Any:
+    """Restore into the structure of ``template``; with ``shardings`` given, arrays
+    are restored directly into the sharded layout."""
+    path = os.path.abspath(os.fspath(path))
+    if shardings is not None:
+        targets = jax.tree.map(
+            lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s), template, shardings
+        )
+    else:
+        targets = jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
+    return _checkpointer().restore(path, targets)

@@ -1,0 +1,36 @@
+"""Loss and metric primitives shared by the task training steps.
+
+Reference semantics: the Lightning wrappers' CE loss with ignore_index=-100
+(/root/reference/perceiver/model/core/lightning.py:48-143).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over positions whose label != IGNORE_INDEX (torch F.cross_entropy
+    ignore_index semantics)."""
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), safe_labels)
+    losses = jnp.where(valid, losses, 0.0)
+    return losses.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    valid = labels != IGNORE_INDEX
+    correct = (logits.argmax(-1) == labels) & valid
+    return correct.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def classification_loss_and_metrics(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, dict]:
+    loss = cross_entropy(logits, labels)
+    return loss, {"loss": loss, "acc": accuracy(logits, labels)}

@@ -1,0 +1,42 @@
+"""Learning-rate schedules as optax schedule functions.
+
+Parity targets (reference: /root/reference/perceiver/scripts/lrs.py):
+  - ``cosine_with_warmup``   -> lrs.py:7-28 (linear warmup, cosine decay with
+    ``num_cycles`` and a ``min_fraction`` floor)
+  - ``constant_with_warmup`` -> lrs.py:31-39
+
+These are pure step -> multiplier functions composed with a base learning rate,
+the JAX-native replacement for torch ``LambdaLR`` wrappers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_with_warmup(
+    base_lr: float,
+    training_steps: int,
+    warmup_steps: int = 0,
+    num_cycles: float = 0.5,
+    min_fraction: float = 0.0,
+):
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(1.0, training_steps - warmup_steps)
+        cosine = min_fraction + jnp.maximum(
+            0.0, 0.5 * (1.0 - min_fraction) * (1.0 + jnp.cos(jnp.pi * num_cycles * 2.0 * progress))
+        )
+        return base_lr * jnp.where(step < warmup_steps, warmup, cosine)
+
+    return schedule
+
+
+def constant_with_warmup(base_lr: float, warmup_steps: int = 0):
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup = step / jnp.maximum(1.0, warmup_steps)
+        return base_lr * jnp.where(step < warmup_steps, warmup, 1.0)
+
+    return schedule

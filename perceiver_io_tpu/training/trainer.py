@@ -1,0 +1,162 @@
+"""Train-state and train-step factories — the JAX replacement for the reference's
+Lightning wrappers (/root/reference/perceiver/model/core/lightning.py and
+model/*/lightning.py).
+
+Design: a step is a pure function (TrainState, batch) -> (TrainState, metrics),
+built once per (model, optimizer) pair and jitted (or pjit-sharded by
+perceiver_io_tpu.parallel). Freezing (the reference's ``freeze`` config flag /
+encoder-frozen fine-tuning, text/classifier/lightning.py:31-36) is an optimizer
+concern here: ``optax.multi_transform`` routes frozen subtrees to ``set_to_zero``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from perceiver_io_tpu.training.losses import IGNORE_INDEX, classification_loss_and_metrics, cross_entropy
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation, rng: Optional[jax.Array] = None) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+        )
+
+
+def build_optimizer(
+    learning_rate_or_schedule,
+    weight_decay: float = 0.0,
+    max_grad_norm: Optional[float] = None,
+    freeze_filter: Optional[Callable[[Tuple[str, ...]], bool]] = None,
+    b1: float = 0.9,
+    b2: float = 0.999,
+) -> optax.GradientTransformation:
+    """AdamW (+ optional global-norm clipping, matching the FSDP CLI's manual
+    clip_grad_norm_, reference scripts/text/clm_fsdp.py:64-67) with optional
+    parameter freezing by path predicate."""
+    chain = []
+    if max_grad_norm is not None:
+        chain.append(optax.clip_by_global_norm(max_grad_norm))
+    chain.append(optax.adamw(learning_rate_or_schedule, b1=b1, b2=b2, weight_decay=weight_decay))
+    tx = optax.chain(*chain)
+
+    if freeze_filter is not None:
+        def label_fn(params):
+            return jax.tree_util.tree_map_with_path(
+                lambda path, _: "frozen" if freeze_filter(tuple(k.key for k in path)) else "trainable",
+                params,
+            )
+
+        tx = optax.multi_transform({"trainable": tx, "frozen": optax.set_to_zero()}, label_fn)
+    return tx
+
+
+def _apply_updates(state: TrainState, tx, grads) -> TrainState:
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+
+
+def make_classifier_train_step(model, tx: optax.GradientTransformation, input_key: str = "image", label_key: str = "label"):
+    """Training step for classification tasks (image or text), mirroring
+    LitClassifier.step (reference core/lightning.py:48-77)."""
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply(params, batch[input_key], pad_mask=batch.get("pad_mask"), rngs={"dropout": rng})
+            return classification_loss_and_metrics(logits, batch[label_key])
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return _apply_updates(state, tx, grads), metrics
+
+    return train_step
+
+
+def make_classifier_eval_step(model, input_key: str = "image", label_key: str = "label"):
+    def eval_step(params, batch):
+        logits = model.apply(params, batch[input_key], pad_mask=batch.get("pad_mask"))
+        _, metrics = classification_loss_and_metrics(logits, batch[label_key])
+        return metrics
+
+    return eval_step
+
+
+def make_mlm_train_step(model, tx: optax.GradientTransformation):
+    """Masked-LM step: CE over positions whose label != -100
+    (reference text/mlm/lightning.py:51-72)."""
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply(params, batch["input_ids"], pad_mask=batch.get("pad_mask"), rngs={"dropout": rng})
+            loss = cross_entropy(logits, batch["labels"])
+            return loss, {"loss": loss}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return _apply_updates(state, tx, grads), metrics
+
+    return train_step
+
+
+def make_causal_lm_train_step(model, tx: optax.GradientTransformation, max_latents: int):
+    """Causal-LM step, mirroring LitCausalSequenceModel.step (reference
+    core/lightning.py:117-133): pad labels -> -100, prefix_len = seq_len -
+    max_latents (static), CE over the latent logits only."""
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        rng = jax.random.fold_in(state.rng, state.step)
+        x = batch["input_ids"]
+        seq_len = x.shape[1]
+        if seq_len < max_latents:
+            raise ValueError(f"sequence length ({seq_len}) must be >= max_latents ({max_latents})")
+        prefix_len = seq_len - max_latents
+
+        labels = batch["labels"]
+        pad_mask = batch.get("pad_mask")
+        if pad_mask is not None:
+            labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        labels = labels[:, prefix_len:]
+
+        def loss_fn(params):
+            logits = model.apply(
+                params, x, prefix_len=prefix_len, pad_mask=pad_mask, rngs={"dropout": rng}
+            )
+            loss = cross_entropy(logits, labels)
+            return loss, {"loss": loss}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return _apply_updates(state, tx, grads), metrics
+
+    return train_step
+
+
+def make_causal_lm_eval_step(model, max_latents: int):
+    def eval_step(params, batch):
+        x = batch["input_ids"]
+        prefix_len = x.shape[1] - max_latents
+        labels = batch["labels"]
+        pad_mask = batch.get("pad_mask")
+        if pad_mask is not None:
+            labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        labels = labels[:, prefix_len:]
+        logits = model.apply(params, x, prefix_len=prefix_len, pad_mask=pad_mask)
+        return {"loss": cross_entropy(logits, labels)}
+
+    return eval_step

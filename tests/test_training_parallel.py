@@ -1,0 +1,188 @@
+"""Training-stack and multi-device sharding tests on the 8-virtual-device CPU mesh
+— coverage the reference never had in CI (its DDP/FSDP paths were GPU-only,
+SURVEY.md §4)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.models.vision.image_classifier.backend import (
+    ClassificationDecoderConfig,
+    ImageClassifier,
+    ImageClassifierConfig,
+    ImageEncoderConfig,
+)
+from perceiver_io_tpu.parallel.api import make_sharded_train_step, shard_train_state
+from perceiver_io_tpu.parallel.mesh import batch_sharding, make_mesh
+from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
+from perceiver_io_tpu.training.trainer import (
+    TrainState,
+    build_optimizer,
+    make_causal_lm_train_step,
+    make_classifier_train_step,
+)
+
+
+def torch_cosine_lambda(step, training_steps, warmup_steps, num_cycles=0.5, min_fraction=0.0):
+    # literal reimplementation of the reference formula (scripts/lrs.py:7-28)
+    if step < warmup_steps:
+        return step / max(1, warmup_steps)
+    progress = (step - warmup_steps) / max(1, training_steps - warmup_steps)
+    return min_fraction + max(0.0, 0.5 * (1.0 - min_fraction) * (1.0 + math.cos(math.pi * num_cycles * 2.0 * progress)))
+
+
+def test_cosine_with_warmup_matches_reference_formula():
+    sched = cosine_with_warmup(3.0, training_steps=100, warmup_steps=10, min_fraction=0.1)
+    for step in [0, 5, 10, 50, 99, 100]:
+        np.testing.assert_allclose(
+            float(sched(step)), 3.0 * torch_cosine_lambda(step, 100, 10, min_fraction=0.1), rtol=1e-6
+        )
+
+
+def test_constant_with_warmup():
+    sched = constant_with_warmup(2.0, warmup_steps=4)
+    np.testing.assert_allclose([float(sched(s)) for s in [0, 2, 4, 100]], [0.0, 1.0, 2.0, 2.0])
+
+
+def tiny_image_classifier():
+    cfg = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(8, 8, 1),
+            num_frequency_bands=4,
+            num_cross_attention_heads=2,
+            num_cross_attention_qk_channels=16,  # adapter channels (19) not head-divisible
+            num_cross_attention_v_channels=16,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=2,
+        ),
+        decoder=ClassificationDecoderConfig(num_classes=2, num_output_query_channels=16),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    return ImageClassifier(config=cfg)
+
+
+def test_image_classifier_learns_toy_task():
+    model = tiny_image_classifier()
+    rng = jax.random.PRNGKey(0)
+    Y = (jax.random.uniform(rng, (64,)) > 0.5).astype(jnp.int32)
+    X = jax.random.normal(rng, (64, 8, 8, 1)) + Y[:, None, None, None] * 2.0
+    params = model.init(rng, X[:2])
+    tx = build_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_classifier_train_step(model, tx))
+    batch = {"image": X, "label": Y}
+    first_loss = None
+    for _ in range(60):
+        state, metrics = step(state, batch)
+        if first_loss is None:
+            first_loss = float(metrics["loss"])
+    assert float(metrics["loss"]) < first_loss * 0.5
+    assert float(metrics["acc"]) > 0.9
+
+
+def test_image_shape_validation():
+    model = tiny_image_classifier()
+    with pytest.raises(ValueError, match="different from required shape"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 1)))
+
+
+def lm_setup(batch=8, seq=16):
+    cfg = CausalSequenceModelConfig(
+        vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+        num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalSequenceModel(config=cfg, deterministic=False)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (batch, seq), 0, 32)
+    batch_data = {
+        "input_ids": x,
+        "labels": jnp.roll(x, -1, axis=1),
+        "pad_mask": jnp.zeros((batch, seq), bool),
+    }
+    params = model.init({"params": rng, "dropout": rng}, x, prefix_len=8)
+    return model, cfg, params, batch_data
+
+
+def test_causal_lm_train_step_runs():
+    model, cfg, params, batch = lm_setup()
+    tx = build_optimizer(cosine_with_warmup(1e-3, 100, 10), max_grad_norm=1.0)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+    losses = []
+    for _ in range(15):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]  # memorizes the fixed batch
+    assert int(state.step) == 15
+
+
+def test_optimizer_freeze_filter():
+    model, cfg, params, batch = lm_setup()
+    # freeze everything under the self-attention stack
+    tx = build_optimizer(1e-2, freeze_filter=lambda path: "self_attention" in path)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+    new_state, _ = step(state, batch)
+    frozen_before = params["params"]["ar"]["self_attention"]["layers"]["mlp"]["dense_1"]["kernel"]
+    frozen_after = new_state.params["params"]["ar"]["self_attention"]["layers"]["mlp"]["dense_1"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(frozen_before), np.asarray(frozen_after))
+    moved = new_state.params["params"]["ar"]["cross_attention"]["cross_attn"]["attention"]["q_proj"]["kernel"]
+    assert not np.allclose(np.asarray(moved), np.asarray(params["params"]["ar"]["cross_attention"]["cross_attn"]["attention"]["q_proj"]["kernel"]))
+
+
+@pytest.mark.parametrize("axes,mode", [
+    ({"data": 8}, "dp"),
+    ({"data": 2, "fsdp": 4}, "fsdp"),
+    ({"fsdp": 2, "tensor": 4}, "fsdp"),
+    ({"data": 2, "fsdp": 2, "tensor": 2}, "fsdp"),
+])
+def test_sharded_training_matches_single_device(axes, mode):
+    """DP / FSDP / TP sharded training must produce the same loss trajectory as
+    unsharded training (XLA SPMD is numerics-preserving up to reduction order)."""
+    assert len(jax.devices()) == 8
+    model, cfg, params, batch = lm_setup()
+    tx = build_optimizer(1e-3)
+
+    # single-device reference trajectory
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+    ref_losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        ref_losses.append(float(metrics["loss"]))
+
+    mesh = make_mesh(axes)
+    sharded_state, state_sh = shard_train_state(TrainState.create(params, tx), mesh, mode=mode, min_fsdp_size=1)
+    sstep = make_sharded_train_step(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents), mesh, state_sh)
+    gbatch = jax.device_put(batch, batch_sharding(mesh))
+    losses = []
+    for _ in range(3):
+        sharded_state, metrics = sstep(sharded_state, gbatch)
+        losses.append(float(metrics["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+    if mode == "fsdp":
+        # verify parameters are actually distributed, not replicated
+        kernel = sharded_state.params["params"]["ar"]["self_attention"]["layers"]["mlp"]["dense_1"]["kernel"]
+        assert not kernel.sharding.is_fully_replicated
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from perceiver_io_tpu.training.checkpoint import restore_checkpoint, save_checkpoint
+
+    model, cfg, params, batch = lm_setup()
+    tx = build_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+    state, _ = step(state, batch)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state)
+    restored = restore_checkpoint(path, state)
+    assert int(restored.step) == 1
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)), state.params, restored.params)
