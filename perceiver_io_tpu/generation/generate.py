@@ -1,0 +1,191 @@
+"""Autoregressive generation for Perceiver AR models.
+
+Parity targets (reference: /root/reference/perceiver/model/core/huggingface.py):
+  - ``generate(num_latents=...)`` semantics and validation errors (exact message
+    strings) -> core/huggingface.py:187-230: the initial number of latents is
+    assigned to the end of the prompt; during generation latents grow to
+    ``max_latents``, then the prefix grows to ``max_prefix_len``, then the window
+    slides by discarding the left-most token.
+  - the latent->prefix->slide window policy itself -> core/huggingface.py:89-156.
+    Here it needs NO per-step cache surgery: the fixed-capacity roll caches of
+    ``PerceiverARCache`` (self-attn capacity = max_latents, cross-attn capacity =
+    max_seq_len) implement the same policy with static shapes.
+  - beam-search cache reordering -> core/huggingface.py:140-144 (``_reorder_cache``).
+
+TPU-first design: the decode loop is a ``lax.scan`` over ``max_new_tokens`` — one
+compiled program, no per-token dispatch; sampling (greedy/temperature/top-k/top-p)
+and EOS bookkeeping run inside the scan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.generation.sampling import process_logits, sample_token
+from perceiver_io_tpu.models.core.perceiver_ar import PerceiverARCache
+from perceiver_io_tpu.ops.attention import KVCache
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 20
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    num_beams: int = 1
+    length_penalty: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def _validate(model, seq_len: int, num_latents: int) -> int:
+    max_seq_len = model.max_seq_len
+    max_latents = model.max_latents
+    if not 0 < seq_len <= max_seq_len:
+        raise ValueError(f"Input sequence length out of valid range [1..{max_seq_len}]")
+    if not 0 < num_latents <= max_latents:
+        raise ValueError(f"num_latents={num_latents} out of valid range [1..{max_latents}]")
+    num_latents = min(seq_len, num_latents)
+    prefix_len = seq_len - num_latents
+    if prefix_len > model.max_prefix_len:
+        num_latents_min = num_latents + prefix_len - model.max_prefix_len
+        raise ValueError(
+            f"For given sequence of length={seq_len}, num_latents must "
+            f"be in range [{num_latents_min}..{max_latents}]"
+        )
+    return prefix_len
+
+
+def reorder_cache(cache: PerceiverARCache, idx: jax.Array) -> PerceiverARCache:
+    """Gather the batch dimension by ``idx`` (beam reordering). The stacked
+    self-attention cache carries batch on axis 1 (axis 0 is the scanned layer)."""
+    return PerceiverARCache(
+        ca=KVCache(k=cache.ca.k[idx], v=cache.ca.v[idx], length=cache.ca.length),
+        sa=KVCache(k=cache.sa.k[:, idx], v=cache.sa.v[:, idx], length=cache.sa.length),
+        pad_slots=cache.pad_slots[idx],
+        shift=cache.shift[idx],
+    )
+
+
+def _cache_dtype(model):
+    return model.dtype if model.dtype is not None else model.param_dtype
+
+
+@partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
+def _generate_single(model, params, input_ids, pad_mask, rng, *, prefix_len: int, config: GenerationConfig):
+    b, seq_len = input_ids.shape
+
+    cache = model.init_cache(batch_size=b, dtype=_cache_dtype(model))
+    logits, cache = model.apply(params, input_ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill)
+    next_logits = logits[:, -1]
+
+    eos = config.eos_token_id
+    finished0 = jnp.zeros((b,), bool)
+
+    def body(carry, step_rng):
+        cache, next_logits, finished = carry
+        processed = process_logits(next_logits, config.temperature, config.top_k, config.top_p)
+        tok = sample_token(step_rng, processed, config.do_sample)
+        if eos is not None:
+            tok = jnp.where(finished, config.pad_token_id, tok)
+            finished = finished | (tok == eos)
+        logits_t, cache = model.apply(params, tok[:, None], cache, method=type(model).decode_step)
+        return (cache, logits_t[:, -1], finished), tok
+
+    rngs = jax.random.split(rng, config.max_new_tokens)
+    (_, _, _), tokens = jax.lax.scan(body, (cache, next_logits, finished0), rngs)
+    return jnp.concatenate([input_ids, tokens.T], axis=1)
+
+
+@partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
+def _generate_beam(model, params, input_ids, pad_mask, rng, *, prefix_len: int, config: GenerationConfig):
+    b, seq_len = input_ids.shape
+    k = config.num_beams
+    eos = config.eos_token_id
+    vocab = model.config.vocab_size
+
+    # expand batch to B*K beams after prefill (all beams identical at step 0)
+    cache = model.init_cache(batch_size=b, dtype=_cache_dtype(model))
+    logits, cache = model.apply(params, input_ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill)
+    tile = jnp.repeat(jnp.arange(b), k)
+    cache = reorder_cache(cache, tile)
+    next_logits = jnp.repeat(logits[:, -1], k, axis=0)  # (B*K, V)
+
+    scores0 = jnp.tile(jnp.asarray([0.0] + [-jnp.inf] * (k - 1)), (b, 1))  # (B, K)
+    tokens0 = jnp.zeros((b, k, config.max_new_tokens), jnp.int32)
+    finished0 = jnp.zeros((b, k), bool)
+    finish_step0 = jnp.full((b, k), config.max_new_tokens, jnp.int32)  # step at which EOS fired
+
+    def body(carry, step):
+        cache, next_logits, scores, tokens, finished, finish_step = carry
+        logp = jax.nn.log_softmax(
+            process_logits(next_logits, config.temperature, config.top_k, config.top_p), axis=-1
+        ).reshape(b, k, vocab)
+        # finished beams may only emit pad with unchanged score
+        pad_only = jnp.full((vocab,), -jnp.inf).at[config.pad_token_id].set(0.0)
+        logp = jnp.where(finished[..., None], pad_only[None, None, :], logp)
+        cand = scores[..., None] + logp  # (B, K, V)
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)  # (B, K)
+        beam_idx = top_idx // vocab
+        tok = (top_idx % vocab).astype(jnp.int32)
+
+        gather = beam_idx + jnp.arange(b)[:, None] * k  # global beam indices
+        cache = reorder_cache(cache, gather.reshape(-1))
+        tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+        tokens = jax.lax.dynamic_update_index_in_dim(tokens, tok, step, axis=2)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        finish_step = jnp.take_along_axis(finish_step, beam_idx, axis=1)
+        if eos is not None:
+            newly = ~finished & (tok == eos)
+            finish_step = jnp.where(newly, step + 1, finish_step)
+            finished = finished | (tok == eos)
+
+        logits_t, cache = model.apply(params, tok.reshape(-1, 1), cache, method=type(model).decode_step)
+        return (cache, logits_t[:, -1], top_scores, tokens, finished, finish_step), None
+
+    carry0 = (cache, next_logits, scores0, tokens0, finished0, finish_step0)
+    (cache, _, scores, tokens, finished, finish_step), _ = jax.lax.scan(
+        body, carry0, jnp.arange(config.max_new_tokens)
+    )
+    # pick best beam (scores already include finished freezing); length penalty
+    # uses the recorded finish step, not a token-value heuristic
+    lengths = finish_step.clip(1)
+    best = (scores / lengths**config.length_penalty).argmax(axis=1)
+    best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]
+    return jnp.concatenate([input_ids, best_tokens], axis=1)
+
+
+def generate(
+    model,
+    params,
+    input_ids: jax.Array,
+    num_latents: int = 1,
+    pad_mask: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    config: Optional[GenerationConfig] = None,
+    **kwargs,
+) -> jax.Array:
+    """Generate ``config.max_new_tokens`` tokens after ``input_ids`` (B, N).
+
+    ``num_latents`` is the initial number of latent positions assigned to the end
+    of the prompt (reference core/huggingface.py:187-230); the latent/prefix
+    window then evolves automatically via the roll caches. Returns (B, N + new).
+    """
+    if config is None:
+        config = GenerationConfig(**kwargs)
+    elif kwargs:
+        raise ValueError("pass either config or keyword options, not both")
+    prefix_len = _validate(model, input_ids.shape[1], num_latents)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if config.num_beams > 1:
+        if config.do_sample:
+            raise ValueError("beam-multinomial sampling (num_beams > 1 with do_sample) is not supported yet")
+        return _generate_beam(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+    return _generate_single(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)

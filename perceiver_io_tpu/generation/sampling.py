@@ -1,0 +1,64 @@
+"""Logit processors for sampling: temperature, top-k, top-p (nucleus).
+
+The reference delegated sampling to HF transformers' GenerationMixin (greedy,
+sampling, beam, contrastive are all exercised in its pipeline tests,
+reference tests/causal_language_model_pipeline_test.py:34-61). Here the
+processors are pure jnp functions usable inside a jitted/scanned decode loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_temperature(logits: jax.Array, temperature: float) -> jax.Array:
+    if temperature == 1.0:
+        return logits
+    if temperature <= 0.0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    return logits / temperature
+
+
+def apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Mask all but the k highest logits; top_k <= 0 means disabled (HF semantics)."""
+    if top_k <= 0:
+        return logits
+    k = min(top_k, logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability exceeds top_p (the highest-probability token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the mass BEFORE it is < top_p
+    keep_sorted = (cum - probs) < top_p
+    # threshold logit = smallest kept logit
+    kth = jnp.take_along_axis(sorted_logits, keep_sorted.sum(-1, keepdims=True) - 1, axis=-1)
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def process_logits(
+    logits: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    logits = apply_temperature(logits, temperature)
+    if top_k is not None and top_k > 0:
+        logits = apply_top_k(logits, top_k)
+    if top_p is not None and top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return logits
+
+
+def sample_token(rng: jax.Array, logits: jax.Array, do_sample: bool) -> jax.Array:
+    if do_sample:
+        return jax.random.categorical(rng, logits, axis=-1)
+    return logits.argmax(axis=-1)

@@ -12,7 +12,7 @@ from dataclasses import asdict, dataclass, field, fields
 from typing import Generic, Optional, Tuple, TypeVar
 
 
-@dataclass
+@dataclass(frozen=True)
 class EncoderConfig:
     num_cross_attention_heads: int = 8
     num_cross_attention_qk_channels: Optional[int] = None
@@ -35,7 +35,7 @@ class EncoderConfig:
         return _base_kwargs(self, EncoderConfig, exclude)
 
 
-@dataclass
+@dataclass(frozen=True)
 class DecoderConfig:
     num_cross_attention_heads: int = 8
     num_cross_attention_qk_channels: Optional[int] = None
@@ -50,7 +50,7 @@ class DecoderConfig:
         return _base_kwargs(self, DecoderConfig, exclude)
 
 
-@dataclass
+@dataclass(frozen=True)
 class ClassificationDecoderConfig(DecoderConfig):
     num_output_queries: int = 1
     num_output_query_channels: int = 256
@@ -61,7 +61,7 @@ E = TypeVar("E", bound=EncoderConfig)
 D = TypeVar("D", bound=DecoderConfig)
 
 
-@dataclass
+@dataclass(frozen=True)
 class PerceiverIOConfig(Generic[E, D]):
     encoder: E
     decoder: D
@@ -71,7 +71,7 @@ class PerceiverIOConfig(Generic[E, D]):
     activation_offloading: bool = False  # accepted for parity; XLA remat has no CPU-offload knob here
 
 
-@dataclass
+@dataclass(frozen=True)
 class PerceiverARConfig:
     num_heads: int = 8
     max_heads_parallel: Optional[int] = None
@@ -94,7 +94,7 @@ def _base_kwargs(config, base_class, exclude):
     return {k: v for k, v in asdict(config).items() if k in base_field_names}
 
 
-@dataclass
+@dataclass(frozen=True)
 class CausalSequenceModelConfig(PerceiverARConfig):
     vocab_size: int = 262
     max_seq_len: int = 4096

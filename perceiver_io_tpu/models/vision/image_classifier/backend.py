@@ -32,7 +32,7 @@ from perceiver_io_tpu.models.core.modules import PerceiverDecoder, PerceiverEnco
 from perceiver_io_tpu.ops.position import fourier_position_encodings, num_fourier_channels
 
 
-@dataclass
+@dataclass(frozen=True)
 class ImageEncoderConfig(EncoderConfig):
     image_shape: Tuple[int, int, int] = (224, 224, 3)
     num_frequency_bands: int = 32
