@@ -1,0 +1,225 @@
+"""Weight conversion: reference torch state dicts -> flax param trees.
+
+This replaces the reference's param-copy surgery (reference
+perceiver/model/core/huggingface.py:21-80 and the per-task ``convert_checkpoint``
+utilities): any state dict produced by the torch reference — including Lightning
+checkpoints, whose keys carry a ``model.`` prefix (reference
+core/lightning.py:12-45) — loads into the corresponding flax model here.
+
+Layout notes (torch reference -> this framework):
+  - torch ``nn.Linear.weight`` is (out, in); flax ``Dense.kernel`` is (in, out):
+    transposed.
+  - attention/MLP layers are ``nn.Sequential`` with ``Residual`` wrappers in torch
+    (keys like ``cross_attention.0.module.attention.q_proj.weight``); decoders
+    built with ``attention_residual=False`` drop the ``.module`` segment — both
+    spellings are probed.
+  - ``SelfAttentionBlock`` params are per-layer in torch (``self_attention.<i>...``)
+    and stacked on a leading layer axis here (``nn.scan``): converted per layer
+    then stacked.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+
+def _t(x) -> np.ndarray:
+    try:  # torch tensor
+        return x.detach().cpu().numpy()
+    except AttributeError:
+        return np.asarray(x)
+
+
+def _ln(sd: Mapping, p: str) -> Dict:
+    return {"scale": _t(sd[f"{p}.weight"]), "bias": _t(sd[f"{p}.bias"])}
+
+
+def _dense(sd: Mapping, p: str) -> Dict:
+    out = {"kernel": _t(sd[f"{p}.weight"]).T}
+    if f"{p}.bias" in sd:
+        out["bias"] = _t(sd[f"{p}.bias"])
+    return out
+
+
+def _embed(sd: Mapping, p: str) -> Dict:
+    return {"embedding": _t(sd[f"{p}.weight"])}
+
+
+def _attention(sd: Mapping, p: str) -> Dict:
+    return {name: _dense(sd, f"{p}.{name}") for name in ("q_proj", "k_proj", "v_proj", "o_proj")}
+
+
+def _seq(p: str, idx: int, sd: Mapping) -> str:
+    """Resolve the torch Sequential element prefix, probing for the Residual
+    ``.module`` wrapper."""
+    wrapped = f"{p}.{idx}.module"
+    return wrapped if any(k.startswith(wrapped + ".") for k in sd) else f"{p}.{idx}"
+
+
+def _mlp(sd: Mapping, p: str) -> Dict:
+    # torch MLP Sequential: 0=LayerNorm, 1=Dense(widening), 2=GELU, 3=Dense
+    return {"norm": _ln(sd, f"{p}.0"), "dense_1": _dense(sd, f"{p}.1"), "dense_2": _dense(sd, f"{p}.3")}
+
+
+def cross_attention_layer(sd: Mapping, p: str) -> Dict:
+    a = _seq(p, 0, sd)
+    return {
+        "cross_attn": {
+            "q_norm": _ln(sd, f"{a}.q_norm"),
+            "kv_norm": _ln(sd, f"{a}.kv_norm"),
+            "attention": _attention(sd, f"{a}.attention"),
+        },
+        "mlp": _mlp(sd, _seq(p, 1, sd)),
+    }
+
+
+def self_attention_layer(sd: Mapping, p: str) -> Dict:
+    a = _seq(p, 0, sd)
+    return {
+        "self_attn": {"norm": _ln(sd, f"{a}.norm"), "attention": _attention(sd, f"{a}.attention")},
+        "mlp": _mlp(sd, _seq(p, 1, sd)),
+    }
+
+
+def self_attention_block(sd: Mapping, p: str, num_layers: int) -> Dict:
+    layers = [self_attention_layer(sd, f"{p}.{i}") for i in range(num_layers)]
+    import jax
+
+    return {"layers": jax.tree.map(lambda *xs: np.stack(xs), *layers)}
+
+
+def _strip_prefix(sd: Mapping, prefix: str) -> Dict:
+    out = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+    return out if out else dict(sd)
+
+
+def _normalize_perceiver_io(sd: Mapping) -> Dict:
+    """torch PerceiverIO subclasses are nn.Sequential(encoder, decoder), so their
+    state-dict keys are ``0.*`` / ``1.*``; rename to ``encoder.*`` / ``decoder.*``."""
+    sd = _strip_prefix(sd, "model.")
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("0."):
+            out["encoder." + k[2:]] = v
+        elif k.startswith("1."):
+            out["decoder." + k[2:]] = v
+        else:
+            out[k] = v
+    return out
+
+
+def token_input_adapter(sd: Mapping, p: str, abs_pos_emb: bool = True) -> Dict:
+    out = {"txt_embedding": _embed(sd, f"{p}.txt_embedding")}
+    if abs_pos_emb and f"{p}.pos_embedding.weight" in sd:
+        out["pos_embedding"] = _embed(sd, f"{p}.pos_embedding")
+    return out
+
+
+def perceiver_encoder(sd: Mapping, p: str, num_layers_per_block: int, input_adapter: Optional[Dict]) -> Dict:
+    out = {
+        "latent_provider": {"query": _t(sd[f"{p}.latent_provider._query"])},
+        "cross_attn_1": cross_attention_layer(sd, f"{p}.cross_attn_1"),
+        "self_attn_1": self_attention_block(sd, f"{p}.self_attn_1", num_layers_per_block),
+    }
+    if input_adapter is not None:
+        out["input_adapter"] = input_adapter
+    if any(k.startswith(f"{p}.cross_attn_n.") for k in sd):
+        out["cross_attn_n"] = cross_attention_layer(sd, f"{p}.cross_attn_n")
+    if any(k.startswith(f"{p}.self_attn_n.") for k in sd):
+        out["self_attn_n"] = self_attention_block(sd, f"{p}.self_attn_n", num_layers_per_block)
+    return out
+
+
+def perceiver_decoder(sd: Mapping, p: str, output_adapter: Optional[Dict], with_query: bool = True) -> Dict:
+    out = {"cross_attn": cross_attention_layer(sd, f"{p}.cross_attn")}
+    if output_adapter is not None:
+        out["output_adapter"] = output_adapter
+    if with_query:
+        out["output_query_provider"] = {"query": _t(sd[f"{p}.output_query_provider._query"])}
+    return out
+
+
+# ------------------------------------------------------------------ per-model
+
+
+def causal_sequence_model_params(state_dict: Mapping, config) -> Dict:
+    """Reference CausalSequenceModel / CausalLanguageModel / SymbolicAudioModel
+    state dict -> flax params for perceiver_io_tpu CausalSequenceModel."""
+    sd = _strip_prefix(state_dict, "model.")
+    ar = {
+        "input_adapter": token_input_adapter(sd, "input_adapter", config.abs_pos_emb),
+        "cross_attention": cross_attention_layer(sd, "cross_attention"),
+        "self_attention": self_attention_block(sd, "self_attention", config.num_self_attention_layers),
+    }
+    params = {"ar": ar}
+    if config.output_norm:
+        params["out_norm"] = _ln(sd, "out_norm")
+    if config.output_bias:
+        params["output_adapter"] = {"bias": _t(sd["output_adapter.bias"])}
+    return {"params": params}
+
+
+def masked_language_model_params(state_dict: Mapping, config) -> Dict:
+    sd = _normalize_perceiver_io(state_dict)
+    encoder = perceiver_encoder(
+        sd,
+        "encoder",
+        config.encoder.num_self_attention_layers_per_block,
+        token_input_adapter(sd, "encoder.input_adapter"),
+    )
+    tied = config.decoder.num_output_query_channels is None
+    if tied:
+        decoder = perceiver_decoder(sd, "decoder", output_adapter=None)
+        params = {"encoder": encoder, "decoder": decoder}
+        if "decoder.output_adapter.bias" in sd:
+            params["tied_bias"] = {"bias": _t(sd["decoder.output_adapter.bias"])}
+    else:
+        decoder = perceiver_decoder(
+            sd, "decoder", output_adapter={"linear": _dense(sd, "decoder.output_adapter.linear")}
+        )
+        params = {"encoder": encoder, "decoder": decoder}
+    return {"params": params}
+
+
+def text_classifier_params(state_dict: Mapping, config) -> Dict:
+    sd = _normalize_perceiver_io(state_dict)
+    encoder = perceiver_encoder(
+        sd,
+        "encoder",
+        config.encoder.num_self_attention_layers_per_block,
+        token_input_adapter(sd, "encoder.input_adapter"),
+    )
+    decoder = perceiver_decoder(
+        sd, "decoder", output_adapter={"linear": _dense(sd, "decoder.output_adapter.linear")}
+    )
+    return {"params": {"encoder": encoder, "decoder": decoder}}
+
+
+def image_classifier_params(state_dict: Mapping, config) -> Dict:
+    sd = _normalize_perceiver_io(state_dict)
+    encoder = perceiver_encoder(
+        sd, "encoder", config.encoder.num_self_attention_layers_per_block, input_adapter=None
+    )  # Fourier features only — no adapter params
+    decoder = perceiver_decoder(
+        sd, "decoder", output_adapter={"linear": _dense(sd, "decoder.output_adapter.linear")}
+    )
+    return {"params": {"encoder": encoder, "decoder": decoder}}
+
+
+def optical_flow_params(state_dict: Mapping, config) -> Dict:
+    sd = _normalize_perceiver_io(state_dict)
+    encoder = perceiver_encoder(
+        sd,
+        "encoder",
+        config.encoder.num_self_attention_layers_per_block,
+        input_adapter={"linear": _dense(sd, "encoder.input_adapter.linear")},
+    )
+    decoder = perceiver_decoder(
+        sd,
+        "decoder",
+        output_adapter={"linear": _dense(sd, "decoder.output_adapter.linear")},
+        with_query=False,  # query is the adapted input — no params
+    )
+    return {"params": {"encoder": encoder, "decoder": decoder}}

@@ -1,0 +1,19 @@
+"""Symbolic audio model over MIDI-event tokens (vocab 389) — a thin alias of
+CausalSequenceModel (parity target:
+/root/reference/perceiver/model/audio/symbolic/backend.py:11-14)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+
+@dataclass(frozen=True)
+class SymbolicAudioModelConfig(CausalSequenceModelConfig):
+    pass
+
+
+class SymbolicAudioModel(CausalSequenceModel):
+    pass

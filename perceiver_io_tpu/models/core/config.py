@@ -56,6 +56,9 @@ class ClassificationDecoderConfig(DecoderConfig):
     num_output_query_channels: int = 256
     num_classes: int = 100
 
+    def base_kwargs(self, exclude=("freeze", "num_output_queries", "num_output_query_channels", "num_classes")):
+        return super().base_kwargs(exclude=exclude)
+
 
 E = TypeVar("E", bound=EncoderConfig)
 D = TypeVar("D", bound=DecoderConfig)

@@ -499,6 +499,10 @@ class PerceiverEncoder(nn.Module):
         if self.extra_self_attention_block:
             self.self_attn_n = self_attn("self_attn_n")
 
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Tied-embedding readout via the input adapter (token adapters only)."""
+        return self.input_adapter.attend(x)
+
     def __call__(self, x: jax.Array, pad_mask: Optional[jax.Array] = None, return_adapted_input: bool = False):
         b = x.shape[0]
         x_adapted = self.input_adapter(x)

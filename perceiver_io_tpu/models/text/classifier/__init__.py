@@ -1,0 +1,1 @@
+from perceiver_io_tpu.models.text.classifier.backend import TextClassifier, TextClassifierConfig

@@ -1,0 +1,66 @@
+"""Text classifier (Perceiver IO encoder + classification decoder).
+
+Parity target: /root/reference/perceiver/model/text/classifier/backend.py:15-47.
+The encoder-frozen fine-tuning recipe (reference text/classifier/lightning.py:31-36)
+is expressed here as an optimizer freeze_filter over the ``encoder`` subtree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import ClassificationOutputAdapter, TrainableQueryProvider
+from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.text.common.backend import TextEncoderConfig, make_text_encoder
+
+TextClassifierConfig = PerceiverIOConfig[TextEncoderConfig, ClassificationDecoderConfig]
+
+
+class TextClassifier(nn.Module):
+    config: TextClassifierConfig
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=ClassificationOutputAdapter(
+                num_classes=cfg.decoder.num_classes,
+                num_output_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            ),
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.num_output_queries,
+                num_query_channels_=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                param_dtype=self.param_dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x: jax.Array, pad_mask: Optional[jax.Array] = None) -> jax.Array:
+        latents = self.encoder(x, pad_mask=pad_mask)
+        return self.decoder(latents)

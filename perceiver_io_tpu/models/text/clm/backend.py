@@ -1,0 +1,18 @@
+"""Causal language model — a thin alias of CausalSequenceModel
+(parity target: /root/reference/perceiver/model/text/clm/backend.py:11-14)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+
+@dataclass(frozen=True)
+class CausalLanguageModelConfig(CausalSequenceModelConfig):
+    pass
+
+
+class CausalLanguageModel(CausalSequenceModel):
+    pass

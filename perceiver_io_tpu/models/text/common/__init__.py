@@ -1,0 +1,1 @@
+from perceiver_io_tpu.models.text.common.backend import TextEncoderConfig, make_text_encoder

@@ -1,0 +1,60 @@
+"""Shared text encoder: token input adapter + Perceiver IO encoder.
+
+Parity target: /root/reference/perceiver/model/text/common/backend.py:9-41
+(``TextEncoderConfig`` fields incl. the ``params``/``freeze`` warm-start flags;
+freezing is applied by the optimizer's freeze_filter in this framework, and
+``params`` warm-starts are handled by the checkpoint loaders).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import TokenInputAdapter
+from perceiver_io_tpu.models.core.config import EncoderConfig
+from perceiver_io_tpu.models.core.modules import PerceiverEncoder
+
+
+@dataclass(frozen=True)
+class TextEncoderConfig(EncoderConfig):
+    vocab_size: int = 10003
+    max_seq_len: int = 256
+    num_input_channels: int = 64
+    params: Optional[str] = None
+
+    def base_kwargs(self, exclude=("freeze", "vocab_size", "max_seq_len", "num_input_channels", "params")):
+        return super().base_kwargs(exclude=exclude)
+
+
+def make_text_encoder(
+    config: TextEncoderConfig,
+    num_latents: int,
+    num_latent_channels: int,
+    activation_checkpointing: bool = False,
+    deterministic: bool = True,
+    dtype: Optional[jnp.dtype] = None,
+    param_dtype: jnp.dtype = jnp.float32,
+    name: str = "encoder",
+) -> PerceiverEncoder:
+    input_adapter = TokenInputAdapter(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_seq_len,
+        num_input_channels_=config.num_input_channels,
+        init_scale=config.init_scale,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+    return PerceiverEncoder(
+        input_adapter=input_adapter,
+        num_latents=num_latents,
+        num_latent_channels=num_latent_channels,
+        activation_checkpointing=activation_checkpointing,
+        deterministic=deterministic,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        name=name,
+        **config.base_kwargs(),
+    )

@@ -1,0 +1,5 @@
+from perceiver_io_tpu.models.text.mlm.backend import (
+    MaskedLanguageModel,
+    MaskedLanguageModelConfig,
+    TextDecoderConfig,
+)

@@ -1,0 +1,107 @@
+"""Masked language model (Perceiver IO with a per-position output query array).
+
+Parity target: /root/reference/perceiver/model/text/mlm/backend.py:
+  - output query = trainable array of length ``decoder.max_seq_len`` (one query
+    per output position)
+  - tied output head when ``num_output_query_channels is None`` (logits via the
+    encoder's token embedding), otherwise an untied ``TokenOutputAdapter``
+  - forward truncates logits to the input length (backend.py:85)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import (
+    TiedTokenOutputAdapter,
+    TokenOutputAdapter,
+    TrainableQueryProvider,
+)
+from perceiver_io_tpu.models.core.config import DecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.text.common.backend import TextEncoderConfig, make_text_encoder
+
+
+@dataclass(frozen=True)
+class TextDecoderConfig(DecoderConfig):
+    num_output_query_channels: Optional[int] = None
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+
+    def base_kwargs(self, exclude=("freeze", "num_output_query_channels", "vocab_size", "max_seq_len")):
+        return super().base_kwargs(exclude=exclude)
+
+
+MaskedLanguageModelConfig = PerceiverIOConfig[TextEncoderConfig, TextDecoderConfig]
+
+
+class _PassThroughAdapter(nn.Module):
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return x
+
+
+class MaskedLanguageModel(nn.Module):
+    config: MaskedLanguageModelConfig
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def tied(self) -> bool:
+        return self.config.decoder.num_output_query_channels is None
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        if self.tied:
+            query_channels = cfg.encoder.num_input_channels
+            output_adapter = _PassThroughAdapter()  # attend+bias applied in __call__
+            self.tied_bias = TiedTokenOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size, param_dtype=self.param_dtype, name="tied_bias"
+            )
+        else:
+            query_channels = cfg.decoder.num_output_query_channels
+            output_adapter = TokenOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size,
+                num_output_query_channels=query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+        self.decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.max_seq_len,
+                num_query_channels_=query_channels,
+                init_scale=cfg.decoder.init_scale,
+                param_dtype=self.param_dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x_masked: jax.Array, pad_mask: Optional[jax.Array] = None) -> jax.Array:
+        _, n = x_masked.shape
+        x_latent = self.encoder(x_masked, pad_mask=pad_mask)
+        x_logits = self.decoder(x_latent)
+        if self.tied:
+            x_logits = self.tied_bias(self.encoder.attend(x_logits))
+        return x_logits[:, :n, :]

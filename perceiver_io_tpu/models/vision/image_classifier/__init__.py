@@ -1,0 +1,6 @@
+from perceiver_io_tpu.models.vision.image_classifier.backend import (
+    ImageClassifier,
+    ImageClassifierConfig,
+    ImageEncoderConfig,
+    ImageInputAdapter,
+)

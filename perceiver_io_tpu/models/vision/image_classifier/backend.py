@@ -123,7 +123,7 @@ class ImageClassifier(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="decoder",
-            **cfg.decoder.base_kwargs(exclude=("freeze", "num_output_queries", "num_output_query_channels", "num_classes")),
+            **cfg.decoder.base_kwargs(),
         )
 
     def __call__(self, x: jax.Array, pad_mask: Optional[jax.Array] = None) -> jax.Array:

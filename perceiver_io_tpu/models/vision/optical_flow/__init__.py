@@ -1,0 +1,6 @@
+from perceiver_io_tpu.models.vision.optical_flow.backend import (
+    OpticalFlow,
+    OpticalFlowConfig,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)

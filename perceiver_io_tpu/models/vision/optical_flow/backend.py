@@ -1,0 +1,184 @@
+"""Perceiver IO optical flow.
+
+Parity targets (reference: /root/reference/perceiver/model/vision/optical_flow/backend.py):
+  - ``OpticalFlowInputAdapter``  -> backend.py:39-60 (2 frames x 27 patch channels
+    concatenated -> Linear(54 -> 64) + Fourier features)
+  - ``OpticalFlowQueryProvider`` -> backend.py:81-92 (the decoder is queried BY the
+    adapted input — one query per pixel, the dense-output Perceiver IO trick)
+  - ``OpticalFlowOutputAdapter`` -> backend.py:63-78 (Linear -> 2 flow channels,
+    divided by rescale_factor, reshaped to the image grid)
+  - ``OpticalFlow``              -> backend.py:95-137 (encoder qk/v channel defaults
+    from the adapter, backend.py:106-110; return_adapted_input=True path)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import InputAdapter
+from perceiver_io_tpu.models.core.config import DecoderConfig, EncoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder, PerceiverEncoder
+from perceiver_io_tpu.ops.position import fourier_position_encodings, num_fourier_channels
+
+
+@dataclass(frozen=True)
+class OpticalFlowEncoderConfig(EncoderConfig):
+    image_shape: Tuple[int, int] = (368, 496)
+    num_patch_input_channels: int = 27
+    num_patch_hidden_channels: int = 64
+    num_frequency_bands: int = 64
+
+    def base_kwargs(
+        self,
+        exclude=("freeze", "image_shape", "num_patch_input_channels", "num_patch_hidden_channels", "num_frequency_bands"),
+    ):
+        return super().base_kwargs(exclude=exclude)
+
+
+@dataclass(frozen=True)
+class OpticalFlowDecoderConfig(DecoderConfig):
+    image_shape: Tuple[int, int] = (368, 496)
+    rescale_factor: float = 100.0
+
+    def base_kwargs(self, exclude=("freeze", "image_shape", "rescale_factor")):
+        return super().base_kwargs(exclude=exclude)
+
+
+OpticalFlowConfig = PerceiverIOConfig[OpticalFlowEncoderConfig, OpticalFlowDecoderConfig]
+
+
+class OpticalFlowInputAdapter(InputAdapter):
+    """(B, 2, C, H, W) frame-pair patch features -> hidden projection + Fourier
+    position features, flattened over the pixel grid."""
+
+    image_shape: Tuple[int, int] = (368, 496)
+    num_patch_input_channels: int = 27
+    num_patch_hidden_channels: int = 64
+    num_frequency_bands: int = 64
+    init_scale: float = 0.02
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_patch_hidden_channels + num_fourier_channels(self.image_shape, self.num_frequency_bands)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, c, h, w = x.shape
+        if t != 2 or c != self.num_patch_input_channels or (h, w) != tuple(self.image_shape):
+            raise ValueError(
+                f"Input shape {(t, c, h, w)} incompatible with (2, {self.num_patch_input_channels}, "
+                f"{self.image_shape[0]}, {self.image_shape[1]})"
+            )
+        # concatenate temporal inputs in the channel dimension: (b, h, w, t*c)
+        x = x.transpose(0, 3, 4, 1, 2).reshape(b, h, w, t * c)
+        x = nn.Dense(
+            self.num_patch_hidden_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="linear",
+        )(x)
+        x = x.reshape(b, h * w, -1)
+        enc = jnp.asarray(fourier_position_encodings(self.image_shape, self.num_frequency_bands))
+        enc = jnp.broadcast_to(enc[None], (b, *enc.shape)).astype(x.dtype)
+        return jnp.concatenate([x, enc], axis=-1)
+
+
+class OpticalFlowOutputAdapter(nn.Module):
+    image_shape: Tuple[int, int] = (368, 496)
+    num_output_image_channels: int = 2
+    rescale_factor: float = 100.0
+    init_scale: float = 0.02
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(
+            self.num_output_image_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="linear",
+        )(x)
+        x = x / self.rescale_factor
+        h, w = self.image_shape
+        return x.reshape(x.shape[0], h, w, self.num_output_image_channels)
+
+
+class OpticalFlowQueryProvider(nn.Module):
+    """The decoder's query IS the adapted input (dense per-pixel queries)."""
+
+    num_query_channels_: int
+
+    @property
+    def num_query_channels(self) -> int:
+        return self.num_query_channels_
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        assert x.shape[-1] == self.num_query_channels_
+        return x
+
+
+class OpticalFlow(nn.Module):
+    config: OpticalFlowConfig
+    deterministic: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        input_adapter = OpticalFlowInputAdapter(
+            image_shape=cfg.encoder.image_shape,
+            num_patch_input_channels=cfg.encoder.num_patch_input_channels,
+            num_patch_hidden_channels=cfg.encoder.num_patch_hidden_channels,
+            num_frequency_bands=cfg.encoder.num_frequency_bands,
+            init_scale=cfg.encoder.init_scale,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        encoder_kwargs = cfg.encoder.base_kwargs()
+        if encoder_kwargs["num_cross_attention_qk_channels"] is None:
+            encoder_kwargs["num_cross_attention_qk_channels"] = input_adapter.num_input_channels
+        if encoder_kwargs["num_cross_attention_v_channels"] is None:
+            encoder_kwargs["num_cross_attention_v_channels"] = input_adapter.num_input_channels
+
+        self.encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="encoder",
+            **encoder_kwargs,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=OpticalFlowOutputAdapter(
+                image_shape=cfg.decoder.image_shape,
+                rescale_factor=cfg.decoder.rescale_factor,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            ),
+            output_query_provider=OpticalFlowQueryProvider(num_query_channels_=input_adapter.num_input_channels),
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            deterministic=self.deterministic,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x_latent, x_adapted = self.encoder(x, return_adapted_input=True)
+        return self.decoder(x_latent, x_adapted=x_adapted)

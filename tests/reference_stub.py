@@ -1,0 +1,46 @@
+"""Import helper for the torch reference at /root/reference.
+
+Stubs the heavyweight training deps (fairscale, pytorch_lightning, torchmetrics)
+the reference's __init__ chains import but its backends don't need, so the
+backend modules can serve as conversion ground truth in tests without network or
+GPU. Test-infrastructure only."""
+
+import sys
+import types
+
+REFERENCE_PATH = "/root/reference"
+
+
+def import_reference():
+    if REFERENCE_PATH not in sys.path:
+        sys.path.insert(0, REFERENCE_PATH)
+
+    import importlib.machinery
+
+    def stub(name, attrs=()):
+        if name in sys.modules:
+            return sys.modules[name]
+        mod = types.ModuleType(name)
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        for a in attrs:
+            setattr(mod, a, type(a, (), {}))
+        sys.modules[name] = mod
+        return mod
+
+    fs = stub("fairscale")
+    fsnn = stub("fairscale.nn")
+    fsnn.checkpoint_wrapper = lambda m, offload_to_cpu=False: m
+    fs.nn = fsnn
+    pl = stub("pytorch_lightning", ["LightningModule", "LightningDataModule", "Trainer", "Callback"])
+    stub("pytorch_lightning.loggers", ["TensorBoardLogger"])
+    util = stub("pytorch_lightning.utilities", [])
+    util.rank_zero_only = lambda f: f
+    stub("torchmetrics", ["Accuracy"])
+    pl.LightningModule.__init__ = lambda self: None
+    tv = stub("torchvision", [])
+    tv.transforms = stub("torchvision.transforms", ["Compose", "Normalize", "ToTensor", "RandomCrop", "CenterCrop", "Lambda"])
+    stub("cv2", [])
+
+    import perceiver  # noqa: F401
+
+    return perceiver

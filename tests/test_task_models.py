@@ -1,0 +1,169 @@
+"""Task-backend tests (reference semantics: perceiver/model/{text,vision,audio}).
+
+Tiny configs per the reference's CPU test strategy (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
+from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.text.common import TextEncoderConfig
+from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, MaskedLanguageModelConfig, TextDecoderConfig
+from perceiver_io_tpu.models.vision.optical_flow import (
+    OpticalFlow,
+    OpticalFlowConfig,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)
+
+ENC = TextEncoderConfig(
+    vocab_size=100,
+    max_seq_len=16,
+    num_input_channels=16,
+    num_cross_attention_heads=2,
+    num_self_attention_heads=2,
+    num_self_attention_layers_per_block=2,
+)
+
+
+def mlm_config(**dec_kwargs):
+    return MaskedLanguageModelConfig(
+        encoder=ENC,
+        decoder=TextDecoderConfig(vocab_size=100, max_seq_len=16, num_cross_attention_heads=2, **dec_kwargs),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+
+
+def test_mlm_tied_forward_and_truncation():
+    model = MaskedLanguageModel(config=mlm_config())
+    x = jnp.zeros((2, 10), jnp.int32)  # shorter than decoder.max_seq_len=16
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 10, 100)  # truncated to input length
+
+
+def test_mlm_tied_has_no_untied_head():
+    model = MaskedLanguageModel(config=mlm_config())
+    x = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    vocab_kernels = [v for p, v in jax.tree_util.tree_leaves_with_path(params) if v.shape[-1:] == (100,) and v.ndim == 2]
+    assert vocab_kernels == []  # logits come from the tied embedding, not a Dense
+
+
+def test_mlm_untied_head():
+    model = MaskedLanguageModel(config=mlm_config(num_output_query_channels=24))
+    x = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 8, 100)
+    vocab_kernels = [v for p, v in jax.tree_util.tree_leaves_with_path(params) if v.ndim == 2 and v.shape == (24, 100)]
+    assert len(vocab_kernels) == 1  # untied TokenOutputAdapter Dense
+
+
+def test_mlm_mask_fill_learns():
+    """A tiny MLM can learn to copy unmasked positions / recover a fixed token."""
+    import optax
+
+    from perceiver_io_tpu.training.losses import IGNORE_INDEX
+    from perceiver_io_tpu.training.trainer import TrainState, build_optimizer, make_mlm_train_step
+
+    model = MaskedLanguageModel(config=mlm_config())
+    rng = jax.random.PRNGKey(0)
+    MASK = 99
+    # data: sequences of a repeated token t; one position masked; label = t there
+    toks = jax.random.randint(rng, (128, 1), 1, 20)
+    x = jnp.tile(toks, (1, 10))
+    labels = jnp.full_like(x, IGNORE_INDEX)
+    labels = labels.at[:, 3].set(x[:, 3])
+    x = x.at[:, 3].set(MASK)
+    params = model.init(rng, x[:2])
+    tx = build_optimizer(3e-3)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_mlm_train_step(model, tx))
+    batch = {"input_ids": x, "labels": labels}
+    first_loss = None
+    for _ in range(300):
+        state, metrics = step(state, batch)
+        if first_loss is None:
+            first_loss = float(metrics["loss"])
+    logits = model.apply(state.params, x)
+    acc = (logits[:, 3].argmax(-1) == labels[:, 3]).mean()
+    assert float(metrics["loss"]) < first_loss * 0.5
+    assert float(acc) > 0.7
+
+
+def test_text_classifier_forward():
+    cfg = TextClassifierConfig(
+        encoder=ENC,
+        decoder=ClassificationDecoderConfig(num_classes=2, num_output_query_channels=16, num_cross_attention_heads=2),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    model = TextClassifier(config=cfg)
+    x = jnp.zeros((3, 12), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    assert model.apply(params, x).shape == (3, 2)
+
+
+def test_clm_and_sam_are_causal_sequence_models():
+    for cls, cfg_cls in [(CausalLanguageModel, CausalLanguageModelConfig), (SymbolicAudioModel, SymbolicAudioModelConfig)]:
+        cfg = cfg_cls(vocab_size=50, max_seq_len=12, max_latents=6, num_channels=16, num_heads=2,
+                      num_self_attention_layers=1, cross_attention_dropout=0.0)
+        model = cls(config=cfg)
+        x = jnp.zeros((2, 10), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), x, prefix_len=4)
+        logits = model.apply(params, x, prefix_len=4)
+        assert logits.shape == (2, 6, 50)
+        # decode path inherited
+        cache = model.init_cache(batch_size=2)
+        _, cache = model.apply(params, x, 4, cache, method=cls.prefill)
+        step_logits, _ = model.apply(params, x[:, :1], cache, method=cls.decode_step)
+        assert step_logits.shape == (2, 1, 50)
+
+
+def flow_config(h=16, w=24):
+    return OpticalFlowConfig(
+        encoder=OpticalFlowEncoderConfig(
+            image_shape=(h, w),
+            num_patch_input_channels=3,
+            num_patch_hidden_channels=16,
+            num_frequency_bands=4,
+            num_cross_attention_heads=2,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=2,
+        ),
+        decoder=OpticalFlowDecoderConfig(image_shape=(h, w), rescale_factor=100.0, num_cross_attention_heads=2),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+
+
+def test_optical_flow_dense_decoding():
+    model = OpticalFlow(config=flow_config())
+    x = jnp.zeros((2, 2, 3, 16, 24))  # (B, frames, C, H, W)
+    params = model.init(jax.random.PRNGKey(0), x)
+    flow = model.apply(params, x)
+    assert flow.shape == (2, 16, 24, 2)  # per-pixel 2-channel flow field
+
+
+def test_optical_flow_rescale():
+    model = OpticalFlow(config=flow_config())
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 3, 16, 24))
+    params = model.init(jax.random.PRNGKey(0), x)
+    flow = model.apply(params, x)
+
+    cfg10 = flow_config()
+    cfg10 = OpticalFlowConfig(
+        encoder=cfg10.encoder,
+        decoder=OpticalFlowDecoderConfig(image_shape=(16, 24), rescale_factor=10.0, num_cross_attention_heads=2),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model10 = OpticalFlow(config=cfg10)
+    flow10 = model10.apply(params, x)
+    np.testing.assert_allclose(np.asarray(flow) * 10.0, np.asarray(flow10), rtol=1e-5)
