@@ -1,0 +1,1 @@
+from perceiver_io_tpu.data.loader import DataLoader

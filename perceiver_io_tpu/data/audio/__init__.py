@@ -1,0 +1,7 @@
+from perceiver_io_tpu.data.audio.datasets import GiantMidiPianoDataModule, MaestroV3DataModule
+from perceiver_io_tpu.data.audio.midi_processor import decode_midi, decode_notes, encode_midi, encode_notes
+from perceiver_io_tpu.data.audio.symbolic import (
+    SymbolicAudioCollator,
+    SymbolicAudioDataModule,
+    SymbolicAudioNumpyDataset,
+)

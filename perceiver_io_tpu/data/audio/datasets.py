@@ -1,0 +1,83 @@
+"""Symbolic-audio dataset fetchers: GiantMIDI-Piano and Maestro V3.
+
+Parity targets (reference: /root/reference/perceiver/data/audio/
+{giantmidi_piano,maestro_v3}.py + utils.py): download/extract the source
+archives and split MIDI files into train/valid directories. Network access
+happens only in ``load_source_dataset``; prepared memmaps work offline.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import shutil
+import urllib.request
+import zipfile
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict
+
+from perceiver_io_tpu.data.audio.symbolic import SymbolicAudioDataModule
+
+GIANTMIDI_URL = "https://github.com/bytedance/GiantMIDI-Piano/releases/download/d1.0/midis_v1.2.zip"
+MAESTRO_URL = "https://storage.googleapis.com/magentadata/datasets/maestro/v3.0.0/maestro-v3.0.0-midi.zip"
+
+
+def _download_and_extract(url: str, target_dir: Path) -> Path:
+    target_dir.mkdir(parents=True, exist_ok=True)
+    archive = target_dir / os.path.basename(url)
+    if not archive.exists():
+        urllib.request.urlretrieve(url, archive)  # noqa: S310
+    extracted = target_dir / "extracted"
+    if not extracted.exists():
+        with zipfile.ZipFile(archive) as zf:
+            zf.extractall(extracted)
+    return extracted
+
+
+@dataclass
+class GiantMidiPianoDataModule(SymbolicAudioDataModule):
+    """GiantMIDI-Piano: deterministic tail split into train/valid
+    (reference data/audio/giantmidi_piano.py)."""
+
+    valid_fraction: float = 0.01
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        root = Path(self.dataset_dir)
+        extracted = _download_and_extract(GIANTMIDI_URL, root / "source")
+        files = sorted(extracted.rglob("**/*.mid")) + sorted(extracted.rglob("**/*.midi"))
+        n_valid = max(1, int(len(files) * self.valid_fraction))
+        train_dir, valid_dir = root / "split" / "train", root / "split" / "valid"
+        for d, split_files in ((train_dir, files[n_valid:]), (valid_dir, files[:n_valid])):
+            d.mkdir(parents=True, exist_ok=True)
+            for f in split_files:
+                target = d / f.name
+                if not target.exists():
+                    shutil.copy(f, target)
+        return {"train": train_dir, "valid": valid_dir}
+
+
+@dataclass
+class MaestroV3DataModule(SymbolicAudioDataModule):
+    """Maestro V3: split by the metadata CSV's split column
+    (reference data/audio/maestro_v3.py)."""
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        root = Path(self.dataset_dir)
+        extracted = _download_and_extract(MAESTRO_URL, root / "source")
+        csv_files = list(extracted.rglob("maestro-v3.0.0.csv"))
+        if not csv_files:
+            raise FileNotFoundError("maestro-v3.0.0.csv not found in extracted archive")
+        base = csv_files[0].parent
+        train_dir, valid_dir = root / "split" / "train", root / "split" / "valid"
+        train_dir.mkdir(parents=True, exist_ok=True)
+        valid_dir.mkdir(parents=True, exist_ok=True)
+        with open(csv_files[0]) as f:
+            for row in csv.DictReader(f):
+                src = base / row["midi_filename"]
+                target_dir = {"train": train_dir, "validation": valid_dir}.get(row["split"])
+                if target_dir is not None and src.exists():
+                    target = target_dir / src.name
+                    if not target.exists():
+                        shutil.copy(src, target)
+        return {"train": train_dir, "valid": valid_dir}

@@ -1,0 +1,190 @@
+"""MIDI event codec: notes <-> Music-Transformer-style event tokens.
+
+Parity target (reference: /root/reference/perceiver/data/audio/midi_processor.py,
+itself adapted from jason9693/midi-neural-processor): the event vocabulary is
+  - note_on   pitch 0..127      -> token 0..127
+  - note_off  pitch 0..127      -> token 128..255
+  - time_shift 10ms..1s (100)   -> token 256..355 (value+1 hundredths of a second)
+  - velocity  32 4-step bins    -> token 356..387
+388 event tokens; the data module adds PAD=388 for a model vocab of 389.
+
+This implementation is dependency-free at its core: it operates on plain
+``Note``/``ControlChange`` records. ``pretty_midi`` is only needed for reading /
+writing actual .mid files and is imported lazily (it is not part of this image).
+Sustain-pedal (CC64) handling matches the reference: notes sounding while the
+pedal is down are extended until the next onset of the same pitch or the pedal
+release, whichever comes first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+RANGE_NOTE_ON = 128
+RANGE_NOTE_OFF = 128
+RANGE_TIME_SHIFT = 100
+RANGE_VEL = 32
+
+NOTE_ON_OFFSET = 0
+NOTE_OFF_OFFSET = RANGE_NOTE_ON
+TIME_SHIFT_OFFSET = RANGE_NOTE_ON + RANGE_NOTE_OFF
+VELOCITY_OFFSET = RANGE_NOTE_ON + RANGE_NOTE_OFF + RANGE_TIME_SHIFT
+NUM_EVENTS = VELOCITY_OFFSET + RANGE_VEL  # 388
+
+
+@dataclass
+class Note:
+    pitch: int
+    velocity: int
+    start: float
+    end: float
+
+
+@dataclass
+class ControlChange:
+    number: int
+    value: int
+    time: float
+
+
+def _apply_sustain(notes: List[Note], control_changes: Sequence[ControlChange]) -> List[Note]:
+    """Extend notes held through a down sustain pedal (CC64 >= 64) until the next
+    onset of the same pitch or the pedal release."""
+    pedal_spans: List[Tuple[float, float]] = []
+    down: Optional[float] = None
+    for cc in sorted((c for c in control_changes if c.number == 64), key=lambda c: c.time):
+        if cc.value >= 64 and down is None:
+            down = cc.time
+        elif cc.value < 64 and down is not None:
+            pedal_spans.append((down, cc.time))
+            down = None
+    if down is not None:
+        pedal_spans.append((down, max((n.end for n in notes), default=down)))
+
+    if not pedal_spans:
+        return sorted(notes, key=lambda n: n.start)
+
+    notes = sorted((replace(n) for n in notes), key=lambda n: n.start)
+    for span_start, span_end in pedal_spans:
+        managed = [n for n in notes if span_start <= n.start <= span_end]
+        # walk backwards: each managed note sustains to the next onset of the same
+        # pitch, or to the pedal release
+        next_onset: Dict[int, float] = {}
+        for n in reversed(managed):
+            n.end = next_onset.get(n.pitch, max(span_end, n.end))
+            next_onset[n.pitch] = n.start
+    return sorted(notes, key=lambda n: n.start)
+
+
+def _time_shift_tokens(prev_time: float, post_time: float) -> List[int]:
+    interval = int(round((post_time - prev_time) * 100))
+    tokens = []
+    while interval >= RANGE_TIME_SHIFT:
+        tokens.append(TIME_SHIFT_OFFSET + RANGE_TIME_SHIFT - 1)
+        interval -= RANGE_TIME_SHIFT
+    if interval > 0:
+        tokens.append(TIME_SHIFT_OFFSET + interval - 1)
+    return tokens
+
+
+def encode_notes(notes: Sequence[Note], control_changes: Sequence[ControlChange] = ()) -> List[int]:
+    """Notes -> event token sequence."""
+    notes = _apply_sustain(list(notes), control_changes)
+    # split into timestamped on/off markers
+    markers: List[Tuple[float, int, int, Optional[int]]] = []  # (time, order, pitch, velocity|None)
+    for n in notes:
+        markers.append((n.start, 0, n.pitch, n.velocity))
+        markers.append((n.end, 1, n.pitch, None))
+    markers.sort(key=lambda m: m[0])
+
+    tokens: List[int] = []
+    cur_time = 0.0
+    cur_vel_bin = 0
+    for time, kind, pitch, velocity in markers:
+        tokens.extend(_time_shift_tokens(cur_time, time))
+        if velocity is not None:
+            vel_bin = velocity // 4
+            if vel_bin != cur_vel_bin:
+                tokens.append(VELOCITY_OFFSET + vel_bin)
+                cur_vel_bin = vel_bin
+            tokens.append(NOTE_ON_OFFSET + pitch)
+        else:
+            tokens.append(NOTE_OFF_OFFSET + pitch)
+        cur_time = time
+    return tokens
+
+
+def decode_notes(tokens: Sequence[int]) -> List[Note]:
+    """Event token sequence -> notes (zero-length notes are dropped; unmatched
+    note_offs are ignored, matching the reference's tolerant decoding)."""
+    timeline = 0.0
+    velocity = 0
+    open_notes: Dict[int, Tuple[float, int]] = {}
+    notes: List[Note] = []
+    for token in tokens:
+        token = int(token)
+        if token < NOTE_OFF_OFFSET:
+            open_notes[token] = (timeline, velocity)
+        elif token < TIME_SHIFT_OFFSET:
+            pitch = token - NOTE_OFF_OFFSET
+            if pitch in open_notes:
+                start, vel = open_notes.pop(pitch)
+                if timeline > start:
+                    notes.append(Note(pitch=pitch, velocity=vel, start=start, end=timeline))
+        elif token < VELOCITY_OFFSET:
+            timeline += (token - TIME_SHIFT_OFFSET + 1) / 100.0
+        elif token < NUM_EVENTS:
+            velocity = (token - VELOCITY_OFFSET) * 4
+    notes.sort(key=lambda n: n.start)
+    return notes
+
+
+# ------------------------------------------------------------- pretty_midi IO
+
+
+def encode_midi(midi) -> List[int]:
+    """pretty_midi.PrettyMIDI -> tokens."""
+    notes: List[Note] = []
+    ccs: List[ControlChange] = []
+    for inst in midi.instruments:
+        notes.extend(Note(n.pitch, n.velocity, n.start, n.end) for n in inst.notes)
+        ccs.extend(ControlChange(c.number, c.value, c.time) for c in inst.control_changes)
+    return encode_notes(notes, ccs)
+
+
+def decode_midi(tokens: Sequence[int], file_path: Optional[str] = None):
+    """Tokens -> pretty_midi.PrettyMIDI (requires pretty_midi)."""
+    import pretty_midi
+
+    notes = decode_notes(tokens)
+    mid = pretty_midi.PrettyMIDI()
+    instrument = pretty_midi.Instrument(1, False, "perceiver-io-tpu")
+    instrument.notes = [pretty_midi.Note(n.velocity, n.pitch, n.start, n.end) for n in notes]
+    mid.instruments.append(instrument)
+    if file_path is not None:
+        mid.write(file_path)
+    return mid
+
+
+def encode_midi_file(path: str) -> Optional[np.ndarray]:
+    try:
+        import pretty_midi
+
+        return np.asarray(encode_midi(pretty_midi.PrettyMIDI(str(path))), dtype=np.int16)
+    except Exception as e:  # noqa: BLE001 — skip unreadable files like the reference
+        print(f"Error encoding midi file [{path}]: {e}")
+        return None
+
+
+def encode_midi_files(files: Sequence[str], num_workers: int = 1) -> List[np.ndarray]:
+    if num_workers > 1:
+        from multiprocessing import Pool
+
+        with Pool(processes=num_workers) as pool:
+            results = pool.map(encode_midi_file, files)
+    else:
+        results = [encode_midi_file(f) for f in files]
+    return [r for r in results if r is not None]

@@ -1,0 +1,46 @@
+"""Minimal numpy data loader: shuffled epochs, collated batches.
+
+The reference delegates loading to torch DataLoader worker processes (reference
+data/text/common.py:210-236). For TPU hosts the idiomatic shape is simpler: the
+collators are cheap numpy ops, batches are handed to ``jax.device_put`` (or
+``make_array_from_process_local_data`` for multi-host), and heavy preprocessing
+happens once, offline (see TextDataModule.prepare_data). This loader keeps the
+epoch/shuffle/collate contract with an explicit RNG and no worker machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Sequence,
+        batch_size: int,
+        collate_fn: Optional[Callable] = None,
+        shuffle: bool = False,
+        drop_last: bool = True,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = self.rng.permutation(n) if self.shuffle else np.arange(n)
+        stop = n - (n % self.batch_size) if self.drop_last else n
+        for start in range(0, stop, self.batch_size):
+            idx = order[start : start + self.batch_size]
+            examples = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(examples) if self.collate_fn else examples

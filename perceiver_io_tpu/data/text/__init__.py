@@ -1,0 +1,24 @@
+from perceiver_io_tpu.data.text.c4 import C4DataModule
+from perceiver_io_tpu.data.text.collator import (
+    Collator,
+    DefaultCollator,
+    RandomTruncateCollator,
+    TokenMaskingCollator,
+    WordMaskingCollator,
+)
+from perceiver_io_tpu.data.text.common import (
+    CLMDataset,
+    RandomShiftDataset,
+    Task,
+    TextDataModule,
+    TextPreprocessor,
+)
+from perceiver_io_tpu.data.text.datasets import (
+    BookCorpusDataModule,
+    BookCorpusOpenDataModule,
+    Enwik8DataModule,
+    ImdbDataModule,
+    WikipediaDataModule,
+    WikiTextDataModule,
+)
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer, get_tokenizer

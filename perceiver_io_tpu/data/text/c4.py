@@ -1,0 +1,125 @@
+"""C4 streaming data module — the multi-host training data path.
+
+Parity targets (reference: /root/reference/perceiver/data/text/c4.py):
+  - streaming + shuffle window + per-node sharding -> c4.py:76-79; the torch
+    reference shards by torch.distributed rank/world_size, here sharding defaults
+    to ``jax.process_index()/process_count()`` (each TPU host streams its own
+    shard — the jax-native ``split_dataset_by_node``)
+  - on-the-fly tokenize -> concat with EOS -> chunk with optional random lengths
+    -> c4.py:81-125
+  - ``C4Collator`` pads and shifts labels by one -> c4.py:155-164
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from perceiver_io_tpu.data.text.common import TextPreprocessor
+from perceiver_io_tpu.data.text.tokenizer import get_tokenizer
+
+os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+
+
+def _jax_rank_world() -> tuple:
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+@dataclass
+class C4DataModule:
+    tokenizer: str = "bytes"
+    max_seq_len: int = 1024
+    min_seq_len: Optional[int] = None
+    batch_size: int = 4
+    shuffle_window_seed: int = 0
+    shuffle_window_size: int = 10000
+    concat_batch_size: int = 16
+    padding_side: Optional[str] = None
+    rank: Optional[int] = None
+    world_size: Optional[int] = None
+
+    def __post_init__(self):
+        self._tokenizer = get_tokenizer(self.tokenizer)
+        if self.padding_side is not None:
+            self._tokenizer.padding_side = self.padding_side
+        self._rng = np.random.default_rng(self.shuffle_window_seed)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tokenizer.vocab_size
+
+    def _rank_world(self):
+        r, w = _jax_rank_world()
+        return (self.rank if self.rank is not None else r, self.world_size if self.world_size is not None else w)
+
+    def text_preprocessor(self) -> TextPreprocessor:
+        return TextPreprocessor(self.tokenizer, self.max_seq_len, add_special_tokens=False, padding_side=self.padding_side)
+
+    def _create_dataset(self, split: str):
+        from datasets import load_dataset
+        from datasets.distributed import split_dataset_by_node
+
+        dataset = load_dataset("c4", "en", split=split, streaming=True)
+        dataset = dataset.shuffle(seed=self.shuffle_window_seed, buffer_size=self.shuffle_window_size)
+        rank, world = self._rank_world()
+        return split_dataset_by_node(dataset, rank=rank, world_size=world)
+
+    def _chunk_len(self, randomize: bool) -> int:
+        if randomize and self.min_seq_len is not None:
+            return int(self._rng.integers(self.min_seq_len, self.max_seq_len + 1)) + 1
+        return self.max_seq_len + 1
+
+    def _chunks(self, dataset, randomize: bool) -> Iterator[list]:
+        """Tokenize, concatenate with EOS separators, emit fixed-length chunks."""
+        eos = self._tokenizer.eos_token_id
+        buf: list = []
+        target = self._chunk_len(randomize)
+        for example in dataset:
+            buf.extend(self._tokenizer.encode(example["text"]))
+            buf.append(eos)
+            while len(buf) >= target:
+                yield buf[:target]
+                buf = buf[target:]
+                target = self._chunk_len(randomize)
+
+    def _batches(self, split: str, randomize: bool):
+        chunks = []
+        for chunk in self._chunks(self._create_dataset(split), randomize):
+            chunks.append(chunk)
+            if len(chunks) == self.batch_size:
+                yield self._collate(chunks)
+                chunks = []
+
+    def _collate(self, chunks) -> dict:
+        """Pad to the longest chunk, then shift: labels = ids[1:], inputs = ids[:-1]."""
+        pad_id = self._tokenizer.pad_token_id
+        n = max(len(c) for c in chunks)
+        ids = np.full((len(chunks), n), pad_id, dtype=np.int64)
+        attn = np.zeros((len(chunks), n), dtype=bool)
+        left = (self.padding_side or getattr(self._tokenizer, "padding_side", "right")) == "left"
+        for i, c in enumerate(chunks):
+            if left:
+                ids[i, n - len(c):] = c
+                attn[i, n - len(c):] = True
+            else:
+                ids[i, : len(c)] = c
+                attn[i, : len(c)] = True
+        return {
+            "labels": ids[:, 1:],
+            "input_ids": ids[:, :-1],
+            "pad_mask": ~attn[:, :-1],
+        }
+
+    def train_dataloader(self):
+        return self._batches("train", randomize=True)
+
+    def val_dataloader(self):
+        return self._batches("validation", randomize=False)

@@ -1,0 +1,202 @@
+"""Batch collators producing the (labels, input_ids, pad_mask) protocol.
+
+Parity targets (reference: /root/reference/perceiver/data/text/collator.py):
+  - ``Collator.__call__``       -> collator.py:16-22 (labels, input_ids, pad_mask
+    with True = padding)
+  - ``RandomTruncateCollator``  -> collator.py:25-41 (random per-batch seq length)
+  - ``DefaultCollator``         -> collator.py:44-84 (pad/truncate to max_seq_len)
+  - ``WordMaskingCollator``     -> collator.py:87-144 (whole-word masking with the
+    80/10/10 mask/random/keep split)
+  - ``TokenMaskingCollator``    -> collator.py:147-152 (per-token BERT-style MLM)
+
+JAX notes: everything is host-side numpy (batches are device_put later by the
+training loop); masking randomness uses an explicit ``numpy.random.Generator``
+for reproducibility. Labels use -100 as the ignore index, matching
+``training.losses.IGNORE_INDEX``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IGNORE = -100
+
+
+class Collator:
+    """Subclasses implement ``collate(examples) -> dict`` with numpy arrays
+    ``labels``, ``input_ids``, ``attention_mask``."""
+
+    def collate(self, examples: Sequence[dict]) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def __call__(self, examples: Sequence[dict]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        result = self.collate(examples)
+        return result["labels"], result["input_ids"], ~result["attention_mask"].astype(bool)
+
+
+def _pad_batch(
+    sequences: List[List[int]],
+    pad_id: int,
+    padding_side: str = "right",
+    max_len: Optional[int] = None,
+    extra: Optional[List[List[int]]] = None,
+    extra_pad: int = IGNORE,
+) -> Dict[str, np.ndarray]:
+    """Pad (and truncate) a list of token lists; optionally pad a parallel
+    ``extra`` (labels) list with ``extra_pad``."""
+    n = max(len(s) for s in sequences)
+    if max_len is not None:
+        n = min(n, max_len)
+    b = len(sequences)
+    input_ids = np.full((b, n), pad_id, dtype=np.int64)
+    attention = np.zeros((b, n), dtype=np.int64)
+    labels = np.full((b, n), extra_pad, dtype=np.int64) if extra is not None else None
+    for i, seq in enumerate(sequences):
+        seq = seq[:n]
+        if padding_side == "left":
+            input_ids[i, n - len(seq):] = seq
+            attention[i, n - len(seq):] = 1
+            if extra is not None:
+                lab = extra[i][:n]
+                labels[i, n - len(lab):] = lab
+        else:
+            input_ids[i, : len(seq)] = seq
+            attention[i, : len(seq)] = 1
+            if extra is not None:
+                lab = extra[i][:n]
+                labels[i, : len(lab)] = lab
+    out = {"input_ids": input_ids, "attention_mask": attention}
+    if labels is not None:
+        out["labels"] = labels
+    return out
+
+
+class DefaultCollator(Collator):
+    """Pad/truncate to the longest example (capped at max_seq_len). Examples carry
+    ``input_ids`` and either per-position ``label_ids`` or a scalar ``label``."""
+
+    def __init__(self, pad_token_id: int, max_seq_len: Optional[int] = None, padding_side: str = "right"):
+        self.pad_token_id = pad_token_id
+        self.max_seq_len = max_seq_len
+        self.padding_side = padding_side
+
+    def collate(self, examples):
+        seqs = [list(e["input_ids"]) for e in examples]
+        label_seqs = [list(e.get("label_ids", e["input_ids"])) for e in examples]
+        out = _pad_batch(seqs, self.pad_token_id, self.padding_side, self.max_seq_len, extra=label_seqs)
+        if "label" in examples[0]:
+            out["labels"] = np.asarray([e["label"] for e in examples], dtype=np.int64)
+        return out
+
+
+class RandomTruncateCollator(Collator):
+    """Randomly drop 1..(seq_len - min_seq_len) trailing positions per batch, so
+    one model serves many sequence lengths."""
+
+    def __init__(self, collator: Collator, min_seq_len: int, rng: Optional[np.random.Generator] = None):
+        self.collator = collator
+        self.min_seq_len = min_seq_len
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def collate(self, examples):
+        result = self.collator.collate(examples)
+        seq_len = result["input_ids"].shape[1]
+        if seq_len <= self.min_seq_len:
+            return result
+        drop = int(self.rng.integers(1, seq_len - self.min_seq_len + 1))
+        for key in ("labels", "input_ids", "attention_mask"):
+            if result[key].ndim == 2:
+                result[key] = result[key][:, :-drop]
+        return result
+
+
+class WordMaskingCollator(Collator):
+    """Whole-word masking with the 80/10/10 split: of the randomly selected words,
+    80% become mask tokens, 10% random tokens, 10% unchanged. Examples must carry
+    ``word_ids`` (token -> word index or None)."""
+
+    def __init__(
+        self,
+        mask_token_id: int,
+        vocab_size: int,
+        pad_token_id: int,
+        mask_prob: float = 0.15,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.mask_token_id = mask_token_id
+        self.vocab_size = vocab_size
+        self.pad_token_id = pad_token_id
+        self.mask_prob = mask_prob
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def mask_words(self, example: dict) -> dict:
+        word_ids = example["word_ids"]
+        input_ids = list(example["input_ids"])
+        labels = [IGNORE] * len(input_ids)
+
+        # group token indices by word
+        mapping: Dict[int, List[int]] = {}
+        current_word_index = -1
+        current_word_id = None
+        for idx, word_id in enumerate(word_ids):
+            if word_id is not None:
+                if word_id != current_word_id:
+                    current_word_id = word_id
+                    current_word_index += 1
+                mapping.setdefault(current_word_index, []).append(idx)
+
+        mask = self.rng.binomial(1, self.mask_prob, len(mapping))
+        for word_index in np.where(mask)[0]:
+            rand_nr = self.rng.random(2)
+            for idx in mapping[word_index]:
+                labels[idx] = input_ids[idx]
+                if rand_nr[0] < 0.8:
+                    input_ids[idx] = self.mask_token_id
+                elif rand_nr[1] < 0.5:
+                    input_ids[idx] = int(self.rng.integers(self.vocab_size))
+                # else unchanged
+        return {"input_ids": input_ids, "labels": labels}
+
+    def collate(self, examples):
+        masked = [self.mask_words(e) for e in examples]
+        return _pad_batch(
+            [m["input_ids"] for m in masked],
+            self.pad_token_id,
+            extra=[m["labels"] for m in masked],
+        )
+
+
+class TokenMaskingCollator(Collator):
+    """BERT-style per-token masking (80/10/10 applied independently per token)."""
+
+    def __init__(
+        self,
+        mask_token_id: int,
+        vocab_size: int,
+        pad_token_id: int,
+        mask_prob: float = 0.15,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.mask_token_id = mask_token_id
+        self.vocab_size = vocab_size
+        self.pad_token_id = pad_token_id
+        self.mask_prob = mask_prob
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def collate(self, examples):
+        out = _pad_batch([list(e["input_ids"]) for e in examples], self.pad_token_id)
+        input_ids = out["input_ids"]
+        attention = out["attention_mask"].astype(bool)
+        labels = np.full_like(input_ids, IGNORE)
+
+        selected = (self.rng.random(input_ids.shape) < self.mask_prob) & attention
+        labels[selected] = input_ids[selected]
+        roll = self.rng.random(input_ids.shape)
+        input_ids[selected & (roll < 0.8)] = self.mask_token_id
+        random_sel = selected & (roll >= 0.8) & (roll < 0.9)
+        input_ids[random_sel] = self.rng.integers(self.vocab_size, size=int(random_sel.sum()))
+        out["labels"] = labels
+        out["input_ids"] = input_ids
+        return out

@@ -1,0 +1,409 @@
+"""Text data module: tokenize -> chunk -> cache -> collated batches.
+
+Parity targets (reference: /root/reference/perceiver/data/text/common.py):
+  - ``Task`` enum (mlm/clm/clf)            -> common.py:49-52
+  - preprocessing cache keyed by an md5 of the preproc params -> common.py:165-182
+  - tokenize -> chunk(max_seq_len, +1 for clm) -> optional static masking
+                                           -> common.py:255-357
+  - ``RandomShiftDataset`` (random concat-shift augmentation) -> common.py:364-387
+  - ``CLMDataset`` (shift-by-one input/label split) -> common.py:390-399
+  - ``TextPreprocessor`` (inference-side text -> (ids, pad_mask)) -> common.py:25-46
+
+TPU-first redesign: prepared splits are flat fixed-length numpy chunk arrays
+stored as ``.npz`` (memmap-friendly, no torch Dataset machinery); classification
+examples keep ragged token lists. Loading is the numpy DataLoader + collators.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.data.loader import DataLoader
+from perceiver_io_tpu.data.text.collator import (
+    Collator,
+    DefaultCollator,
+    RandomTruncateCollator,
+    TokenMaskingCollator,
+    WordMaskingCollator,
+)
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer, get_tokenizer
+
+WORD_ID_NONE = -1  # encodes None word ids in fixed numpy arrays
+
+
+class Task(Enum):
+    mlm = 0
+    clm = 1
+    clf = 2
+
+
+class TextPreprocessor:
+    """Inference-side preprocessing: text -> (input_ids, pad_mask)."""
+
+    def __init__(self, tokenizer: str, max_seq_len: int, add_special_tokens: bool = False, padding_side: Optional[str] = None):
+        self.tokenizer = get_tokenizer(tokenizer)
+        self.max_seq_len = max_seq_len
+        self.add_special_tokens = add_special_tokens
+        if padding_side is not None:
+            self.tokenizer.padding_side = padding_side
+
+    def preprocess(self, text: str) -> Tuple[np.ndarray, np.ndarray]:
+        xs, pad = self.preprocess_batch([text])
+        return xs[0], pad[0]
+
+    def preprocess_batch(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        seqs = [self.tokenizer.encode(t, self.add_special_tokens)[: self.max_seq_len] for t in texts]
+        n = max(len(s) for s in seqs)
+        ids = np.full((len(seqs), n), self.tokenizer.pad_token_id, dtype=np.int64)
+        pad = np.ones((len(seqs), n), dtype=bool)
+        for i, s in enumerate(seqs):
+            if getattr(self.tokenizer, "padding_side", "right") == "left":
+                ids[i, n - len(s):] = s
+                pad[i, n - len(s):] = False
+            else:
+                ids[i, : len(s)] = s
+                pad[i, : len(s)] = False
+        return ids, pad
+
+
+class ChunkDataset:
+    """Fixed-length chunks stored as (N, chunk_len) memmaps; items are dicts.
+    ``labels`` is present for statically-masked MLM data (inputs already masked)."""
+
+    def __init__(
+        self,
+        chunks: np.ndarray,
+        word_ids: Optional[np.ndarray] = None,
+        labels: Optional[np.ndarray] = None,
+    ):
+        self.chunks = chunks
+        self.word_ids = word_ids
+        self.labels = labels
+
+    def __len__(self):
+        return len(self.chunks)
+
+    def __getitem__(self, idx: int) -> dict:
+        out = {"input_ids": self.chunks[idx].tolist()}
+        if self.labels is not None:
+            out["label_ids"] = self.labels[idx].tolist()
+        elif self.word_ids is not None:
+            out["word_ids"] = [None if w == WORD_ID_NONE else int(w) for w in self.word_ids[idx]]
+        return out
+
+
+class RandomShiftDataset:
+    """Concatenation-shift augmentation: example i is chunk[i][s:] + chunk[i+1][:s]
+    with a random shift s (reference common.py:364-387)."""
+
+    def __init__(self, dataset, rng: Optional[np.random.Generator] = None):
+        self.dataset = dataset
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def __len__(self):
+        return len(self.dataset) - 1
+
+    def __getitem__(self, idx: int) -> dict:
+        e1, e2 = self.dataset[idx], self.dataset[idx + 1]
+        shift = None
+        out = {}
+        for key in e1:
+            if shift is None:
+                shift = int(self.rng.integers(len(e1[key])))
+            out[key] = list(e1[key][shift:]) + list(e2[key][:shift])
+        return out
+
+
+class CLMDataset:
+    """Shift-by-one split of (max_seq_len + 1)-length chunks into inputs/labels."""
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int) -> dict:
+        record = self.dataset[idx]["input_ids"]
+        return {"input_ids": record[:-1], "label_ids": record[1:]}
+
+
+class ClfDataset:
+    """Ragged tokenized examples with scalar labels."""
+
+    def __init__(self, input_ids: List[List[int]], labels: List[int]):
+        self.input_ids = input_ids
+        self.labels = labels
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx: int) -> dict:
+        return {"input_ids": self.input_ids[idx], "label": int(self.labels[idx])}
+
+
+def chunk_token_stream(token_lists: Sequence[Sequence[int]], chunk_size: int) -> np.ndarray:
+    """Concatenate token lists and split into fixed chunks, dropping the tail."""
+    flat = np.concatenate([np.asarray(t, dtype=np.int32) for t in token_lists]) if token_lists else np.zeros(0, np.int32)
+    n = (len(flat) // chunk_size) * chunk_size
+    return flat[:n].reshape(-1, chunk_size)
+
+
+class ChunkFileWriter:
+    """Streams token sequences into an on-disk int32 chunk file: O(chunk) host
+    memory regardless of corpus size (flagship corpora like Wikipedia/C4 never
+    fit in RAM as Python lists; prepared files are memmapped at load time)."""
+
+    def __init__(self, path: str, chunk_size: int):
+        self.path = path
+        self.chunk_size = chunk_size
+        self._fh = open(path, "wb")
+        self._buf = np.zeros(0, np.int32)
+        self.num_chunks = 0
+
+    def write(self, tokens: Sequence[int]) -> None:
+        self._buf = np.concatenate([self._buf, np.asarray(tokens, np.int32)])
+        n = (len(self._buf) // self.chunk_size) * self.chunk_size
+        if n:
+            self._fh.write(self._buf[:n].astype(np.int32).tobytes())
+            self.num_chunks += n // self.chunk_size
+            self._buf = self._buf[n:]
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def open_chunk_file(path: str, chunk_size: int) -> np.ndarray:
+    data = np.memmap(path, dtype=np.int32, mode="r")
+    return data.reshape(-1, chunk_size)
+
+
+@dataclass
+class TextDataModule:
+    """Base class for text datasets; subclasses implement ``load_source_dataset``
+    returning {'train': ..., 'valid': ...} where each split is a list of texts
+    (mlm/clm) or (texts, labels) (clf)."""
+
+    dataset_dir: str
+    tokenizer: str = "bytes"
+    max_seq_len: int = 4096
+    task: Task = Task.mlm
+    mask_prob: float = 0.15
+    mask_words: bool = True
+    static_masking: bool = False
+    add_special_tokens: bool = False
+    add_eos_token: bool = False
+    padding_side: Optional[str] = None
+    random_train_shift: bool = False
+    random_valid_shift: bool = False
+    random_train_truncation: bool = False
+    random_valid_truncation: bool = False
+    random_min_seq_len: int = 16
+    batch_size: int = 64
+    valid_batch_size_: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        self._tokenizer = get_tokenizer(self.tokenizer)
+        if self.padding_side is not None:
+            self._tokenizer.padding_side = self.padding_side
+        if self.static_masking and not self.mask_words:
+            raise ValueError("static_masking=true is only supported for mask_words=true")
+        self.ds_train = None
+        self.ds_valid = None
+        self._rng = np.random.default_rng(self.seed)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def vocab_size(self) -> int:
+        return self._tokenizer.vocab_size
+
+    @property
+    def valid_batch_size(self) -> int:
+        return self.valid_batch_size_ or self.batch_size
+
+    @property
+    def random_shift(self) -> bool:
+        return self.random_train_shift or self.random_valid_shift
+
+    def preproc_dir_hash_input(self) -> str:
+        h = f"{self.tokenizer}-{self.max_seq_len}-{self.task.name}-{self.random_shift}"
+        if self.task == Task.mlm and self.static_masking:
+            h = f"{h}-{self.mask_words}-{self.mask_prob}"
+        if self.add_special_tokens:
+            h = f"{h}-st"
+        if self.add_eos_token:
+            h = f"{h}-eos"
+        return h
+
+    @property
+    def preproc_dir(self) -> str:
+        digest = hashlib.md5(self.preproc_dir_hash_input().encode()).hexdigest()
+        return os.path.join(self.dataset_dir, "preproc", digest)
+
+    # ------------------------------------------------------------ preparation
+    def load_source_dataset(self) -> Dict:
+        raise NotImplementedError
+
+    def prepare_data(self) -> None:
+        if os.path.exists(self.preproc_dir):
+            return
+        source = self.load_source_dataset()
+        # write into a temp dir and rename at the end, so an interrupted run never
+        # leaves a partial cache that would be mistaken for a complete one
+        tmp_dir = f"{self.preproc_dir}.tmp-{os.getpid()}"
+        os.makedirs(tmp_dir, exist_ok=True)
+        try:
+            for split, data in source.items():
+                self._prepare_split(tmp_dir, split, data)
+            os.replace(tmp_dir, self.preproc_dir)
+        finally:
+            if os.path.exists(tmp_dir):
+                import shutil
+
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    def _tokenize_one(self, text: str, with_word_ids: bool):
+        tok = self._tokenizer
+        if self.add_eos_token:
+            text = text + (tok.eos_token if isinstance(tok.eos_token, str) else "")
+        ids = tok.encode(text, self.add_special_tokens)
+        if not with_word_ids:
+            return ids, None
+        if isinstance(tok, ByteTokenizer):
+            wids = tok.word_ids(ids)
+        else:
+            enc = tok(text, add_special_tokens=self.add_special_tokens)
+            wids = enc.word_ids(0)
+        return ids, [WORD_ID_NONE if w is None else w for w in wids]
+
+    @property
+    def _chunk_size(self) -> int:
+        return self.max_seq_len + 1 if self.task == Task.clm else self.max_seq_len
+
+    def _prepare_split(self, out_dir: str, split: str, data) -> None:
+        if self.task == Task.clf:
+            texts, labels = data
+            ids_list = [self._tokenize_one(t, False)[0][: self.max_seq_len] for t in texts]
+            np.savez(
+                os.path.join(out_dir, f"{split}.npz"),
+                input_ids=np.asarray(ids_list, dtype=object),
+                labels=np.asarray(labels, dtype=np.int64),
+            )
+            return
+
+        # mlm/clm: stream texts into on-disk chunk files (O(chunk) host memory)
+        with_word_ids = self.task == Task.mlm
+        ids_writer = ChunkFileWriter(os.path.join(out_dir, f"{split}.ids.bin"), self._chunk_size)
+        wid_writer = (
+            ChunkFileWriter(os.path.join(out_dir, f"{split}.wids.bin"), self._chunk_size) if with_word_ids else None
+        )
+        for text in data:
+            ids, wids = self._tokenize_one(text, with_word_ids)
+            ids_writer.write(ids)
+            if wid_writer is not None:
+                wid_writer.write(wids)
+        ids_writer.close()
+        if wid_writer is not None:
+            wid_writer.close()
+
+        if self.task == Task.mlm and self.static_masking:
+            self._mask_split(out_dir, split)
+
+    def _mask_split(self, out_dir: str, split: str) -> None:
+        """Static masking at preparation time (reference common.py:262-263,344-357):
+        rewrite chunk inputs with masks applied and store the per-position labels."""
+        wmc = self._masking_collator()
+        chunks = open_chunk_file(os.path.join(out_dir, f"{split}.ids.bin"), self._chunk_size)
+        word_ids = open_chunk_file(os.path.join(out_dir, f"{split}.wids.bin"), self._chunk_size)
+        masked_path = os.path.join(out_dir, f"{split}.ids.masked.bin")
+        labels_path = os.path.join(out_dir, f"{split}.labels.bin")
+        with open(masked_path, "wb") as mf, open(labels_path, "wb") as lf:
+            for i in range(len(chunks)):
+                wids = [None if w == WORD_ID_NONE else int(w) for w in word_ids[i]]
+                masked = wmc.mask_words({"input_ids": chunks[i].tolist(), "word_ids": wids})
+                mf.write(np.asarray(masked["input_ids"], np.int32).tobytes())
+                lf.write(np.asarray(masked["labels"], np.int32).tobytes())
+        os.replace(masked_path, os.path.join(out_dir, f"{split}.ids.bin"))
+
+    def _load_split(self, split: str):
+        clf_path = os.path.join(self.preproc_dir, f"{split}.npz")
+        if os.path.exists(clf_path):
+            data = np.load(clf_path, allow_pickle=True)
+            return ClfDataset([list(x) for x in data["input_ids"]], data["labels"].tolist())
+        chunks = open_chunk_file(os.path.join(self.preproc_dir, f"{split}.ids.bin"), self._chunk_size)
+        wids_path = os.path.join(self.preproc_dir, f"{split}.wids.bin")
+        labels_path = os.path.join(self.preproc_dir, f"{split}.labels.bin")
+        return ChunkDataset(
+            chunks,
+            word_ids=open_chunk_file(wids_path, self._chunk_size) if os.path.exists(wids_path) else None,
+            labels=open_chunk_file(labels_path, self._chunk_size) if os.path.exists(labels_path) else None,
+        )
+
+    def setup(self) -> None:
+        self.ds_train = self._load_split("train")
+        self.ds_valid = self._load_split("valid")
+        if self.task in (Task.clm, Task.mlm):
+            if self.random_train_shift:
+                self.ds_train = RandomShiftDataset(self.ds_train, self._rng)
+            if self.random_valid_shift:
+                self.ds_valid = RandomShiftDataset(self.ds_valid, self._rng)
+        if self.task == Task.clm:
+            self.ds_train = CLMDataset(self.ds_train)
+            self.ds_valid = CLMDataset(self.ds_valid)
+
+    # ----------------------------------------------------------------- loading
+    def _masking_collator(self):
+        tok = self._tokenizer
+        cls = WordMaskingCollator if self.mask_words else TokenMaskingCollator
+        return cls(
+            mask_token_id=tok.mask_token_id,
+            vocab_size=tok.vocab_size,
+            pad_token_id=tok.pad_token_id,
+            mask_prob=self.mask_prob,
+            rng=self._rng,
+        )
+
+    def _collator(self) -> Collator:
+        tok = self._tokenizer
+        if self.task == Task.mlm and not self.static_masking:
+            return self._masking_collator()
+        return DefaultCollator(
+            pad_token_id=tok.pad_token_id,
+            max_seq_len=self.max_seq_len,
+            padding_side=self.padding_side or getattr(tok, "padding_side", "right"),
+        )
+
+    def _dataloader(
+        self, dataset, batch_size: int, shuffle: bool, random_truncation: bool, drop_last: bool = True
+    ) -> DataLoader:
+        collator = self._collator()
+        if random_truncation:
+            collator = RandomTruncateCollator(collator, self.random_min_seq_len, rng=self._rng)
+
+        def collate(examples):
+            labels, input_ids, pad_mask = collator(examples)
+            return {"labels": labels, "input_ids": input_ids, "pad_mask": pad_mask}
+
+        return DataLoader(dataset, batch_size, collate_fn=collate, shuffle=shuffle, drop_last=drop_last, rng=self._rng)
+
+    def train_dataloader(self) -> DataLoader:
+        return self._dataloader(
+            self.ds_train, self.batch_size, shuffle=True, random_truncation=self.random_train_truncation
+        )
+
+    def val_dataloader(self) -> DataLoader:
+        # evaluation sees the full set (no batch-truncation of metrics)
+        return self._dataloader(
+            self.ds_valid, self.valid_batch_size, shuffle=False,
+            random_truncation=self.random_valid_truncation, drop_last=False,
+        )
+
+    def text_preprocessor(self) -> TextPreprocessor:
+        return TextPreprocessor(self.tokenizer, self.max_seq_len, self.add_special_tokens, self.padding_side)

@@ -1,0 +1,102 @@
+"""Tokenization: a self-contained UTF-8 byte tokenizer plus HF-tokenizer loading.
+
+The reference's flagship text configs tokenize raw UTF-8 bytes with the
+``deepmind/language-perceiver`` tokenizer (vocab 262 = 6 special tokens + 256
+bytes; reference docs/training-examples.md:32-34, data/text/utils.py:6-39).
+``ByteTokenizer`` reimplements that public vocabulary layout natively so all
+byte-level workflows run with zero network access; any other tokenizer name is
+resolved through ``transformers.AutoTokenizer``.
+
+Word ids (for whole-word masking) follow the reference's whitespace-boundary
+reconstruction (reference data/text/utils.py:13-39): whitespaces preceding a
+word share its word id; special tokens get None.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SPECIAL_TOKENS = ["[PAD]", "[BOS]", "[EOS]", "[MASK]", "[CLS]", "[SEP]"]
+_BYTE_OFFSET = len(_SPECIAL_TOKENS)  # 6
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer with the deepmind/language-perceiver vocab layout."""
+
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+    mask_token_id = 3
+    cls_token_id = 4
+    sep_token_id = 5
+
+    pad_token = "[PAD]"
+    eos_token = "[EOS]"
+    mask_token = "[MASK]"
+
+    vocab_size = _BYTE_OFFSET + 256  # 262
+    padding_side = "right"
+
+    def __init__(self):
+        self._whitespace_ids = {b + _BYTE_OFFSET for b in string.whitespace.encode("utf-8")}
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        ids = [b + _BYTE_OFFSET for b in text.encode("utf-8", errors="replace")]
+        if add_special_tokens:
+            ids = [self.cls_token_id] + ids + [self.sep_token_id]
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i - _BYTE_OFFSET for i in ids if i >= _BYTE_OFFSET)
+        text = data.decode("utf-8", errors="replace")
+        if not skip_special_tokens:
+            specials = "".join(_SPECIAL_TOKENS[i] for i in ids if i < _BYTE_OFFSET)
+            return specials + text if specials else text
+        return text
+
+    def __call__(self, texts, add_special_tokens: bool = False, **_):
+        if isinstance(texts, str):
+            texts = [texts]
+        return {"input_ids": [self.encode(t, add_special_tokens) for t in texts]}
+
+    def word_ids(self, token_ids: Sequence[int]) -> List[Optional[int]]:
+        """Whitespace-boundary word ids (reference data/text/utils.py:13-39)."""
+        word_ids: List[Optional[int]] = []
+        curr_id = 0
+        regular_token = True
+        for token_id in token_ids:
+            if token_id < _BYTE_OFFSET:  # special token
+                word_ids.append(None)
+                curr_id += 1
+            elif token_id in self._whitespace_ids:
+                if regular_token:
+                    regular_token = False
+                    curr_id += 1
+                word_ids.append(curr_id)
+            else:
+                regular_token = True
+                word_ids.append(curr_id)
+        return word_ids
+
+
+BYTE_TOKENIZER_NAMES = {"bytes", "deepmind/language-perceiver", "krasserm/perceiver-io-mlm"}
+
+
+def get_tokenizer(name: str):
+    """'bytes' (or the perceiver byte-tokenizer repo names) -> ByteTokenizer;
+    anything else -> transformers AutoTokenizer."""
+    if name in BYTE_TOKENIZER_NAMES:
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(name, verbose=False)
+
+
+def tokenizer_word_ids(tokenizer, encoding, index: int, input_ids: Sequence[int]):
+    """Word ids for fast tokenizers (via the encoding) or ByteTokenizer."""
+    if isinstance(tokenizer, ByteTokenizer):
+        return tokenizer.word_ids(input_ids)
+    return encoding.word_ids(index)

@@ -1,0 +1,2 @@
+from perceiver_io_tpu.data.vision.mnist import MNISTDataModule, mnist_transform
+from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor, render_optical_flow

@@ -1,0 +1,106 @@
+"""MNIST data module (numpy transforms, HF datasets source).
+
+Parity target: /root/reference/perceiver/data/vision/mnist.py — normalize to
+[-1, 1] (mean 0.5 / std 0.5), channels-last, optional random-crop augmentation.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from perceiver_io_tpu.data.loader import DataLoader
+
+
+def mnist_transform(
+    images: np.ndarray, normalize: bool = True, channels_last: bool = True,
+    random_crop: Optional[int] = None, rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """(B, 28, 28) uint8 -> float32 with the reference's transform stack."""
+    x = images.astype(np.float32) / 255.0
+    if random_crop is not None:
+        rng = rng if rng is not None else np.random.default_rng()
+        b, h, w = x.shape
+        out = np.empty((b, random_crop, random_crop), np.float32)
+        for i in range(b):
+            top = int(rng.integers(0, h - random_crop + 1))
+            left = int(rng.integers(0, w - random_crop + 1))
+            out[i] = x[i, top : top + random_crop, left : left + random_crop]
+        x = out
+    if normalize:
+        x = (x - 0.5) / 0.5
+    return x[..., None] if channels_last else x[:, None]
+
+
+class _MnistSplit:
+    def __init__(self, images, labels, transform):
+        self.images = images
+        self.labels = labels
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        image = self.transform(self.images[idx : idx + 1])[0]
+        return {"image": image, "label": int(self.labels[idx])}
+
+
+@dataclass
+class MNISTDataModule:
+    dataset_dir: str = os.path.join(".cache", "mnist")
+    normalize: bool = True
+    channels_last: bool = True
+    random_crop: Optional[int] = None
+    batch_size: int = 64
+    shuffle: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        self.ds_train = None
+        self.ds_valid = None
+        self._rng = np.random.default_rng(self.seed)
+
+    @property
+    def num_classes(self) -> int:
+        return 10
+
+    @property
+    def image_shape(self):
+        side = self.random_crop or 28
+        return (side, side, 1) if self.channels_last else (1, side, side)
+
+    def _load(self, split: str):
+        from datasets import load_dataset
+
+        ds = load_dataset("mnist", split=split, cache_dir=self.dataset_dir)
+        images = np.stack([np.asarray(img) for img in ds["image"]])
+        labels = np.asarray(ds["label"], dtype=np.int64)
+        return images, labels
+
+    def prepare_data(self) -> None:
+        self._load("train")
+        self._load("test")
+
+    def setup(self) -> None:
+        tr_images, tr_labels = self._load("train")
+        va_images, va_labels = self._load("test")
+        tf_train = lambda im: mnist_transform(im, self.normalize, self.channels_last, self.random_crop, self._rng)
+        tf_valid = lambda im: mnist_transform(im, self.normalize, self.channels_last, None)
+        self.ds_train = _MnistSplit(tr_images, tr_labels, tf_train)
+        self.ds_valid = _MnistSplit(va_images, va_labels, tf_valid)
+
+    def _collate(self, examples):
+        return {
+            "image": np.stack([e["image"] for e in examples]),
+            "label": np.asarray([e["label"] for e in examples], dtype=np.int64),
+        }
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(self.ds_train, self.batch_size, collate_fn=self._collate, shuffle=self.shuffle, rng=self._rng)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(self.ds_valid, self.batch_size, collate_fn=self._collate, shuffle=False, drop_last=False)
