@@ -119,6 +119,7 @@ class MultiHeadAttention(nn.Module):
     qkv_bias: bool = True
     out_bias: bool = True
     kernel_init_scale: float = 0.02
+    use_flash: Optional[bool] = None  # None = auto (TPU + supported shapes)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -189,6 +190,28 @@ class MultiHeadAttention(nn.Module):
             q = apply_rope(q, rope_q)
         if rope_k is not None:
             k = apply_rope(k, rope_k)
+
+        # TPU fast path: fused splash (flash) attention — no materialized
+        # (Nq, Nk) matrix. Falls through to the XLA formulation when unsupported
+        # (caches, attention dropout, mismatched qk/v head widths, odd shapes).
+        from perceiver_io_tpu.ops.flash import flash_supported, splash_mha
+
+        has_dropout = self.dropout > 0.0 and not self.deterministic
+        flash_ok = flash_supported(
+            num_qk // self.num_heads, num_v // self.num_heads, n_q, n_k, has_dropout, kv_cache is not None
+        )
+        if self.use_flash is True and not flash_ok:
+            raise ValueError(
+                "use_flash=True but this attention call cannot use the splash kernel "
+                f"(backend={jax.default_backend()}, devices={jax.device_count()}, n_q={n_q}, n_k={n_k}, "
+                f"dropout={has_dropout}, cached={kv_cache is not None}); use use_flash=None for auto fallback"
+            )
+        if self.use_flash is not False and flash_ok:
+            if q.shape[0] != k.shape[0]:  # broadcast (1, ...) queries for vmap
+                q = jnp.broadcast_to(q, (k.shape[0], *q.shape[1:]))
+            o = splash_mha(q, k, v, pad_mask=pad_mask, causal=self.causal_attention)
+            o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
+            return self.o_proj(o), kv_cache
 
         # fp32 logits + softmax for numerical stability in bf16 compute
         attn = jnp.einsum("bhic,bhjc->bhij", q, k, preferred_element_type=jnp.float32)

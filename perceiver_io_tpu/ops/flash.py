@@ -1,0 +1,111 @@
+"""Flash (splash) attention fast path for TPU.
+
+The hot attention shapes in this framework are skewed: Perceiver AR's prefix
+cross-attention attends 512 latent queries to up to ~8k keys under a
+right-aligned causal mask (SURVEY.md §7 'hard parts') — neither standard
+flash-causal nor full-bidirectional. Pallas splash attention expresses exactly
+this as ``CausalMask((Nq, Nk), offset=Nk-Nq)`` and provides fused forward and
+backward kernels, replacing the O(Nq*Nk) materialized attention matrix (the
+reference's torch einsum, modules.py:151-163) with O(block) VMEM traffic.
+
+Padding is expressed through segment ids (pad kv tokens get segment 0, real
+tokens 1; all queries are real in the paths that use this — Perceiver AR latents
+are the sequence suffix).
+
+Known limitation (tracked for the next round): under a multi-chip SPMD mesh the
+pallas call is not auto-partitioned by XLA; multi-chip runs should wrap it in
+shard_map over the head/batch axes. Single-chip jit (the bench path) is the
+supported configuration today; CPU test runs fall back to the XLA formulation
+via ``flash_supported``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 256
+_DISABLE_ENV = "PERCEIVER_IO_TPU_DISABLE_FLASH"
+
+
+def flash_supported(
+    num_qk_channels_per_head: int,
+    num_v_channels_per_head: int,
+    n_q: int,
+    n_k: int,
+    has_dropout: bool,
+    has_cache: bool,
+) -> bool:
+    """Static predicate: can the splash kernel serve this attention call?"""
+    if os.environ.get(_DISABLE_ENV, "").lower() not in ("", "0", "false"):
+        return False
+    if has_dropout or has_cache:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if jax.device_count() > 1:
+        # the pallas call is not auto-partitioned by XLA SPMD; multi-chip meshes
+        # need the shard_map wrapper (tracked) — fall back rather than break
+        return False
+    if num_qk_channels_per_head != num_v_channels_per_head:
+        return False  # splash assumes one head_dim for q/k/v
+    if num_qk_channels_per_head % 64 != 0:
+        return False
+    block = min(_BLOCK, n_q, n_k)
+    return n_q % block == 0 and n_k % block == 0 and n_q >= 128 and n_k >= 128
+
+
+@functools.lru_cache(maxsize=64)
+def _kernel(num_heads: int, n_q: int, n_k: int, causal: bool, interpret: bool):
+    import jax.experimental.pallas.ops.tpu.splash_attention as sa
+
+    # This is usually reached inside a jit trace; mask-info preprocessing must
+    # produce concrete arrays (they get cached), not tracers.
+    with jax.ensure_compile_time_eval():
+        return _build_kernel(sa, num_heads, n_q, n_k, causal, interpret)
+
+
+def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, causal: bool, interpret: bool):
+    if causal:
+        # right-aligned causal: query row i sees keys 0..(n_k - n_q + i)
+        head_mask = sa.CausalMask((n_q, n_k), offset=n_k - n_q)
+    else:
+        head_mask = sa.FullMask((n_q, n_k))
+    mask = sa.MultiHeadMask([head_mask for _ in range(num_heads)])
+    block = min(_BLOCK, n_q, n_k)
+    bs = sa.BlockSizes(
+        block_q=block, block_kv=block, block_kv_compute=block,
+        block_q_dkv=block, block_kv_dkv=block, block_kv_dkv_compute=block,
+        block_q_dq=block, block_kv_dq=block,
+    )
+    return sa.make_splash_mha(mask, head_shards=1, q_seq_shards=1, block_sizes=bs, interpret=interpret)
+
+
+def splash_mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pad_mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """q (B, H, Nq, D) [pre-scaled, pre-rotated], k/v (B, H, Nk, D),
+    pad_mask (B, Nk) True=padding. Returns (B, H, Nq, D)."""
+    import jax.experimental.pallas.ops.tpu.splash_attention as sa
+
+    b, h, n_q, _ = q.shape
+    n_k = k.shape[2]
+    kernel = _kernel(h, n_q, n_k, causal, interpret)
+
+    if pad_mask is None:
+        return jax.vmap(kernel)(q, k, v)
+
+    seg_q = jnp.ones((b, n_q), jnp.int32)
+    seg_kv = jnp.where(pad_mask, 0, 1).astype(jnp.int32)
+    return jax.vmap(lambda q, k, v, sq, skv: kernel(q, k, v, segment_ids=sa.SegmentIds(sq, skv)))(
+        q, k, v, seg_q, seg_kv
+    )
