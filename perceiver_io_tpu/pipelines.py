@@ -1,0 +1,147 @@
+"""Inference pipelines: text generation, optical flow, symbolic audio.
+
+Parity targets (reference registers these as HF ``transformers`` pipelines):
+  - text generation        -> the reference relies on HF TextGenerationPipeline
+    over PerceiverCausalLanguageModel (tests/causal_language_model_pipeline_test.py)
+  - ``OpticalFlowPipeline``("optical-flow") -> reference
+    vision/optical_flow/huggingface.py:71-124 (patch preprocess, micro-batched
+    forward, distance-weighted blending, optional rendering)
+  - ``SymbolicAudioPipeline``("symbolic-audio-generation") -> reference
+    audio/symbolic/huggingface.py:63-200 (MIDI -> tokens -> generate -> MIDI;
+    optional fluidsynth WAV render via subprocess)
+
+Here pipelines are plain classes over (model, params) pairs — jitted apply under
+the hood, no framework registry required.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.data.text.tokenizer import get_tokenizer
+from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor, render_optical_flow
+from perceiver_io_tpu.generation.generate import GenerationConfig, generate
+
+
+@dataclass
+class TextGenerationPipeline:
+    """Prompt text -> generated text for CausalSequenceModel-family models."""
+
+    model: object
+    params: object
+    tokenizer: Union[str, object] = "bytes"
+    # prompts are always LEFT-padded: the reference enforces left padding for
+    # causal LMs (text/clm/lightning.py:45-48) and the decode slice relies on it
+
+    def __post_init__(self):
+        self._tokenizer = get_tokenizer(self.tokenizer) if isinstance(self.tokenizer, str) else self.tokenizer
+
+    def __call__(
+        self,
+        prompts: Union[str, Sequence[str]],
+        num_latents: int = 1,
+        rng: Optional[jax.Array] = None,
+        **generation_kwargs,
+    ) -> Union[str, List[str]]:
+        single = isinstance(prompts, str)
+        texts = [prompts] if single else list(prompts)
+        tok = self._tokenizer
+        seqs = [tok.encode(t) for t in texts]
+        n = max(len(s) for s in seqs)
+        ids = np.full((len(seqs), n), tok.pad_token_id, np.int64)
+        pad = np.ones((len(seqs), n), bool)
+        for i, s in enumerate(seqs):  # left padding
+            ids[i, n - len(s):] = s
+            pad[i, n - len(s):] = False
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray(ids),
+            num_latents=num_latents,
+            pad_mask=jnp.asarray(pad),
+            rng=rng,
+            **generation_kwargs,
+        )
+        decoded = [tok.decode([t for t in row[n:].tolist() if t != tok.pad_token_id]) for row in np.asarray(out)]
+        results = [prompt + cont for prompt, cont in zip(texts, decoded)]
+        return results[0] if single else results
+
+
+@dataclass
+class OpticalFlowPipeline:
+    """Frame pairs -> dense flow fields (optionally rendered to RGB)."""
+
+    model: object
+    params: object
+    patch_size: Tuple[int, int] = (368, 496)
+    patch_min_overlap: int = 20
+    flow_scale_factor: int = 20
+    micro_batch_size: int = 1
+
+    def __post_init__(self):
+        self.processor = OpticalFlowProcessor(self.patch_size, self.patch_min_overlap, self.flow_scale_factor)
+        self._apply = jax.jit(lambda p, x: self.model.apply(p, x))
+
+    def __call__(self, image_pairs: Sequence[Tuple[np.ndarray, np.ndarray]], render: bool = False):
+        flow = self.processor.process(
+            lambda x: self._apply(self.params, jnp.asarray(x)), list(image_pairs), batch_size=self.micro_batch_size
+        )
+        if render:
+            return np.stack([render_optical_flow(f) for f in flow])
+        return flow
+
+
+@dataclass
+class SymbolicAudioPipeline:
+    """MIDI (file or PrettyMIDI) -> continued MIDI via a SymbolicAudioModel;
+    optional WAV rendering through fluidsynth (subprocess, like the reference)."""
+
+    model: object
+    params: object
+
+    def __call__(
+        self,
+        midi: object,
+        num_latents: int = 1,
+        max_prompt_tokens: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
+        output_midi_path: Optional[str] = None,
+        render_wav_path: Optional[str] = None,
+        soundfont_path: Optional[str] = None,
+        **generation_kwargs,
+    ):
+        import pretty_midi
+
+        from perceiver_io_tpu.data.audio.midi_processor import decode_midi, encode_midi
+
+        if isinstance(midi, (str, Path)):
+            midi = pretty_midi.PrettyMIDI(str(midi))
+        tokens = encode_midi(midi)
+        if max_prompt_tokens is not None:
+            tokens = tokens[-max_prompt_tokens:]
+        prompt = jnp.asarray(tokens, jnp.int32)[None]
+        out = generate(self.model, self.params, prompt, num_latents=num_latents, rng=rng, **generation_kwargs)
+        generated = decode_midi(np.asarray(out[0]).tolist(), file_path=output_midi_path)
+        if render_wav_path is not None:
+            self.render_wav(generated, render_wav_path, soundfont_path)
+        return generated
+
+    @staticmethod
+    def render_wav(midi, wav_path: str, soundfont_path: Optional[str] = None) -> None:
+        """Render MIDI to WAV with fluidsynth (reference
+        audio/symbolic/huggingface.py:160-190 uses the same subprocess approach)."""
+        with tempfile.NamedTemporaryFile(suffix=".mid") as f:
+            midi.write(f.name)
+            cmd = ["fluidsynth", "-ni", "-F", wav_path]
+            if soundfont_path:
+                cmd.insert(1, soundfont_path)
+            cmd.append(f.name)
+            subprocess.run(cmd, check=True, capture_output=True)

@@ -1,0 +1,88 @@
+"""Shared training-script machinery: optimizer flags, warm starts, runners.
+
+Parity targets: the reference's CLI base + trainer defaults
+(/root/reference/perceiver/scripts/cli.py, scripts/trainer.yaml) and the
+``params=<ckpt or repo>`` warm-start dispatch (core/lightning.py:145-147); the
+text classifier's encoder-only warm start from an MLM checkpoint
+(text/classifier/lightning.py:31-36) becomes a param-subtree copy here.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.training.checkpoint import load_pytree
+from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
+from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
+from perceiver_io_tpu.training.trainer import TrainState, build_optimizer
+
+
+@dataclass
+class OptimizerFlags:
+    lr: float = 1e-3
+    weight_decay: float = 0.0
+    warmup_steps: int = 500
+    schedule: str = "cosine"  # "cosine" | "constant"
+    min_fraction: float = 0.0
+    max_grad_norm: Optional[float] = None
+    freeze_encoder: bool = False  # classifier fine-tuning: freeze encoder params
+
+
+def build_tx(flags: OptimizerFlags, max_steps: int):
+    if flags.schedule == "cosine":
+        schedule = cosine_with_warmup(flags.lr, max_steps, flags.warmup_steps, min_fraction=flags.min_fraction)
+    elif flags.schedule == "constant":
+        schedule = constant_with_warmup(flags.lr, flags.warmup_steps)
+    else:
+        raise ValueError(f"unknown schedule '{flags.schedule}'")
+    freeze_filter = (lambda path: "encoder" in path) if flags.freeze_encoder else None
+    return build_optimizer(
+        schedule,
+        weight_decay=flags.weight_decay,
+        max_grad_norm=flags.max_grad_norm,
+        freeze_filter=freeze_filter,
+    )
+
+
+def load_encoder_params(checkpoint_dir: str, target_params):
+    """Copy the encoder subtree out of a (TrainState or bare-params) checkpoint
+    into another model's params — the reference's encoder-only warm start
+    (text/classifier/lightning.py:31-36). Shapes must match; mismatches raise."""
+    tree = load_pytree(checkpoint_dir)
+    source = tree.get("params", tree)  # TrainState pytree or bare params
+    encoder = source["params"]["encoder"]
+    jax.tree.map(
+        lambda a, b: (_ for _ in ()).throw(
+            ValueError(f"encoder shape mismatch: {jnp.shape(a)} vs {jnp.shape(b)}")
+        ) if jnp.shape(a) != jnp.shape(b) else None,
+        encoder,
+        target_params["params"]["encoder"],
+    )
+    target = dict(target_params)
+    target["params"] = dict(target["params"])
+    target["params"]["encoder"] = jax.tree.map(jnp.asarray, encoder)
+    return target
+
+
+def run_fit(
+    trainer_cfg: TrainerConfig,
+    state: TrainState,
+    train_step: Callable,
+    data_module,
+    eval_step: Optional[Callable] = None,
+    on_eval: Optional[Callable] = None,
+) -> TrainState:
+    trainer = Trainer(trainer_cfg)
+    return trainer.fit(
+        state,
+        train_step,
+        train_loader_fn=data_module.train_dataloader,
+        eval_step=eval_step,
+        eval_loader_fn=data_module.val_dataloader if eval_step else None,
+        on_eval=on_eval,
+    )

@@ -1,0 +1,39 @@
+"""Offline text-dataset preparation CLI (reference scripts/text/preproc.py).
+
+  python -m perceiver_io_tpu.scripts.text.preproc wikitext --task=clm \\
+      --dataset_dir=.cache/wikitext --max_seq_len=4096
+"""
+
+from __future__ import annotations
+
+import sys
+
+from perceiver_io_tpu.data.text import datasets as ds
+from perceiver_io_tpu.utils.cli import CLI
+
+MODULES = {
+    "wikitext": ds.WikiTextDataModule,
+    "wikipedia": ds.WikipediaDataModule,
+    "bookcorpus": ds.BookCorpusDataModule,
+    "bookcorpusopen": ds.BookCorpusOpenDataModule,
+    "enwik8": ds.Enwik8DataModule,
+    "imdb": ds.ImdbDataModule,
+}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in MODULES:
+        raise SystemExit(f"usage: preproc {{{','.join(MODULES)}}} [--<field>=<value> ...]")
+    name = argv.pop(0)
+    cls = MODULES[name]
+    cli = CLI(description=f"Prepare the {name} dataset", argv=argv)
+    cli.add_group(name, cls, dict(dataset_dir=f".cache/{name}"))
+    args = cli.parse()
+    dm = cli.build(name, args)
+    dm.prepare_data()
+    print(f"prepared -> {dm.preproc_dir}")
+
+
+if __name__ == "__main__":
+    main()

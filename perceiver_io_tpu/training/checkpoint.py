@@ -26,6 +26,13 @@ def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
     ckpt.wait_until_finished()  # StandardCheckpointer saves asynchronously
 
 
+def load_pytree(path: str) -> Any:
+    """Restore a checkpoint as its saved pytree structure (no template) — for
+    structure-agnostic access like cross-model warm starts."""
+    path = os.path.abspath(os.fspath(path))
+    return _checkpointer().restore(path)
+
+
 def restore_checkpoint(path: str, template: Any, shardings: Optional[Any] = None) -> Any:
     """Restore into the structure of ``template``; with ``shardings`` given, arrays
     are restored directly into the sharded layout."""

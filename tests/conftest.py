@@ -17,7 +17,7 @@ import jax  # noqa: E402
 # (overriding JAX_PLATFORMS env); the config update below wins over both.
 jax.config.update("jax_platforms", "cpu")
 
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache", "cpu")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 

@@ -1,0 +1,52 @@
+"""Pipeline tests (text generation, optical flow) with tiny models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.generation.generate import GenerationConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.vision.optical_flow import (
+    OpticalFlow,
+    OpticalFlowConfig,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)
+from perceiver_io_tpu.pipelines import OpticalFlowPipeline, TextGenerationPipeline
+
+
+def test_text_generation_pipeline_roundtrip():
+    cfg = CausalLanguageModelConfig(
+        vocab_size=262, max_seq_len=64, max_latents=16, num_channels=16, num_heads=2,
+        num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(config=cfg)
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(model.init, static_argnames="prefix_len")(rng, jnp.zeros((1, 16), jnp.int32), prefix_len=8)
+    pipe = TextGenerationPipeline(model, params, tokenizer="bytes")
+    out = pipe("Hello wor", num_latents=4, config=GenerationConfig(max_new_tokens=8))
+    assert isinstance(out, str) and out.startswith("Hello wor") and len(out) > len("Hello wor")
+    # batched prompts of different lengths exercise left padding
+    outs = pipe(["Hi", "A longer prompt"], num_latents=4, config=GenerationConfig(max_new_tokens=4))
+    assert len(outs) == 2 and outs[0].startswith("Hi") and outs[1].startswith("A longer prompt")
+
+
+def test_optical_flow_pipeline_end_to_end():
+    cfg = OpticalFlowConfig(
+        encoder=OpticalFlowEncoderConfig(
+            image_shape=(8, 8), num_patch_input_channels=27, num_patch_hidden_channels=16,
+            num_frequency_bands=2, num_cross_attention_heads=2,
+            num_self_attention_heads=2, num_self_attention_layers_per_block=1,
+        ),
+        decoder=OpticalFlowDecoderConfig(image_shape=(8, 8), num_cross_attention_heads=2),
+        num_latents=4, num_latent_channels=16,
+    )
+    model = OpticalFlow(config=cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 2, 27, 8, 8)))
+    pipe = OpticalFlowPipeline(model, params, patch_size=(8, 8), patch_min_overlap=2)
+    img = np.random.RandomState(0).randint(0, 255, (12, 12, 3), np.uint8)
+    flow = pipe([(img, img)])
+    assert flow.shape == (1, 12, 12, 2)
+    rendered = pipe([(img, img)], render=True)
+    assert rendered.shape == (1, 12, 12, 3) and rendered.dtype == np.uint8
