@@ -1,0 +1,123 @@
+"""Ring attention: sequence/context parallelism for long-context attention.
+
+The reference has NO sequence parallelism (SURVEY.md §2.7) — its long-context
+story is purely architectural (Perceiver AR latent compression). This module
+goes beyond the reference: the prefix key/value sequence is sharded over a
+``seq`` mesh axis, and attention runs as a ring — each device computes a partial
+flash-style (running max/sum) attention against its local KV shard, then rotates
+the shards around the ring with ``lax.ppermute`` over ICI until every device has
+seen every block. Peak per-device KV memory drops from O(n) to O(n / seq_shards),
+so the Perceiver AR prefix cross-attention scales to sequences that cannot fit
+on one chip.
+
+Masking supports the framework's right-aligned causal convention (query row i of
+an Nq-row query block sees global key columns 0..(Nk_total - Nq + i)) and key
+pad masks; blocks of the ring that are fully masked for every query are still
+visited (the ring is oblivious) but contribute zero weight through the running
+softmax.
+
+Communication note: the ring permutation moves KV blocks between ICI neighbours
+only (mesh axes are laid out so ``seq`` is adjacent), overlapping compute on the
+current block with the transfer of the next under XLA's latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, pad, *, axis_name: str, nk_total: int, causal: bool):
+    """shard_map body. q (b, h, nq, d) replicated over the seq axis; k/v
+    (b, h, nk_local, d) and pad (b, nk_local) are this device's shard."""
+    num_shards = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, h, nq, d = q.shape
+    nk_local = k.shape[2]
+
+    m0 = jnp.full((b, h, nq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, nq, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, nq, d), jnp.float32)
+
+    q_pos = nk_total - nq + jnp.arange(nq)  # right-aligned global query positions
+
+    def accumulate(i, k_cur, v_cur, pad_cur, m, l, o):
+        shard_id = (me - i) % num_shards  # global index of the block currently held
+        col_global = shard_id * nk_local + jnp.arange(nk_local)
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur, preferred_element_type=jnp.float32)
+        visible = jnp.ones((nq, nk_local), bool)
+        if causal:
+            visible = col_global[None, :] <= q_pos[:, None]
+        mask = visible[None, None] & ~pad_cur[:, None, None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+
+        # running softmax merge (flash-attention accumulators)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        # guard: fully-masked rows keep m = -inf; exp(-inf - -inf) -> use where
+        scale = jnp.where(jnp.isfinite(m), jnp.exp(m - jnp.where(jnp.isfinite(m_new), m_new, 0.0)), 0.0)
+        p_blk = jnp.exp(jnp.where(jnp.isfinite(s), s - jnp.where(jnp.isfinite(m_new), m_new, 0.0), -jnp.inf))
+        l = l * scale + p_blk.sum(-1, keepdims=True)
+        o = o * scale + jnp.einsum("bhqk,bhkd->bhqd", p_blk, v_cur.astype(jnp.float32))
+        return m_new, l, o
+
+    def body(i, carry):
+        k_cur, v_cur, pad_cur, m, l, o = carry
+        m, l, o = accumulate(i, k_cur, v_cur, pad_cur, m, l, o)
+        # rotate KV (and pad) blocks one step around the ring
+        perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        pad_cur = jax.lax.ppermute(pad_cur, axis_name, perm)
+        return k_cur, v_cur, pad_cur, m, l, o
+
+    # rotate only between blocks: S-1 (compute + rotate) iterations, then a
+    # final compute — no wasted last ring transfer
+    k_c, v_c, pad_c, m, l, o = jax.lax.fori_loop(0, num_shards - 1, body, (k, v, pad, m0, l0, o0))
+    m, l, o = accumulate(num_shards - 1, k_c, v_c, pad_c, m, l, o)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    pad_mask: Optional[jax.Array] = None,
+    causal: bool = True,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+) -> jax.Array:
+    """Sequence-parallel attention over a mesh.
+
+    q (B, H, Nq, D) — queries (e.g. Perceiver AR latents), replicated over the
+        ``seq`` axis, batch-sharded over ``batch_axes`` present in the mesh.
+    k/v (B, H, Nk, D) — keys/values with Nk sharded over ``seq``.
+    pad_mask (B, Nk) True = padding.
+    causal: right-aligned causal masking (the Perceiver AR convention).
+    """
+    try:
+        from jax import shard_map  # JAX >= 0.8
+    except ImportError:  # pragma: no cover - older JAX
+        from jax.experimental.shard_map import shard_map
+
+    if pad_mask is None:
+        pad_mask = jnp.zeros(k.shape[:1] + k.shape[2:3], bool)
+
+    baxes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    bspec = baxes if baxes else None
+    q_spec = P(bspec, None, None, None)
+    kv_spec = P(bspec, None, seq_axis, None)
+    pad_spec = P(bspec, seq_axis)
+
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=seq_axis, nk_total=k.shape[2], causal=causal),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, pad_spec),
+        out_specs=q_spec,
+    )
+    return fn(q, k, v, pad_mask)
