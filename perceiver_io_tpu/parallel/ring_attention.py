@@ -31,19 +31,27 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _ring_attention_local(q, k, v, pad, *, axis_name: str, nk_total: int, causal: bool):
-    """shard_map body. q (b, h, nq, d) replicated over the seq axis; k/v
-    (b, h, nk_local, d) and pad (b, nk_local) are this device's shard."""
+def _ring_attention_local(q, k, v, pad, *, axis_name: str, vary_axes, nq_total: int, nk_total: int, causal: bool):
+    """shard_map body. q (b, h, nq_local, d), k/v (b, h, nk_local, d), and pad
+    (b, nk_local) are this device's shards of the query / key sequences."""
     num_shards = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     b, h, nq, d = q.shape
     nk_local = k.shape[2]
 
-    m0 = jnp.full((b, h, nq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, nq, 1), jnp.float32)
-    o0 = jnp.zeros((b, h, nq, d), jnp.float32)
+    # accumulators must carry the same varying-axis type as the rotating KV
+    # shards for the fori_loop carry (jax.shard_map tracks per-axis variance)
+    m0, l0, o0 = jax.lax.pvary(
+        (
+            jnp.full((b, h, nq, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, nq, 1), jnp.float32),
+            jnp.zeros((b, h, nq, d), jnp.float32),
+        ),
+        vary_axes,
+    )
 
-    q_pos = nk_total - nq + jnp.arange(nq)  # right-aligned global query positions
+    # right-aligned GLOBAL positions of this device's query rows
+    q_pos = nk_total - nq_total + me * nq + jnp.arange(nq)
 
     def accumulate(i, k_cur, v_cur, pad_cur, m, l, o):
         shard_id = (me - i) % num_shards  # global index of the block currently held
@@ -94,8 +102,9 @@ def ring_attention(
 ) -> jax.Array:
     """Sequence-parallel attention over a mesh.
 
-    q (B, H, Nq, D) — queries (e.g. Perceiver AR latents), replicated over the
-        ``seq`` axis, batch-sharded over ``batch_axes`` present in the mesh.
+    q (B, H, Nq, D) — queries (e.g. Perceiver AR latents), sharded over the
+        ``seq`` axis (Nq divisible by the axis size), batch-sharded over
+        ``batch_axes`` present in the mesh.
     k/v (B, H, Nk, D) — keys/values with Nk sharded over ``seq``.
     pad_mask (B, Nk) True = padding.
     causal: right-aligned causal masking (the Perceiver AR convention).
@@ -110,12 +119,19 @@ def ring_attention(
 
     baxes = tuple(a for a in batch_axes if a in mesh.axis_names)
     bspec = baxes if baxes else None
-    q_spec = P(bspec, None, None, None)
+    q_spec = P(bspec, None, seq_axis, None)
     kv_spec = P(bspec, None, seq_axis, None)
     pad_spec = P(bspec, seq_axis)
 
     fn = shard_map(
-        partial(_ring_attention_local, axis_name=seq_axis, nk_total=k.shape[2], causal=causal),
+        partial(
+            _ring_attention_local,
+            axis_name=seq_axis,
+            vary_axes=(seq_axis, *baxes),
+            nq_total=q.shape[2],
+            nk_total=k.shape[2],
+            causal=causal,
+        ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, pad_spec),
         out_specs=q_spec,
