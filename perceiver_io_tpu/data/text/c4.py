@@ -80,10 +80,12 @@ class C4DataModule:
     def _chunks(self, dataset, randomize: bool) -> Iterator[list]:
         """Tokenize, concatenate with EOS separators, emit fixed-length chunks."""
         eos = self._tokenizer.eos_token_id
+        tok = self._tokenizer
+        encode = tok.encode_array if hasattr(tok, "encode_array") else tok.encode
         buf: list = []
         target = self._chunk_len(randomize)
         for example in dataset:
-            buf.extend(self._tokenizer.encode(example["text"]))
+            buf.extend(encode(example["text"]))
             buf.append(eos)
             while len(buf) >= target:
                 yield buf[:target]

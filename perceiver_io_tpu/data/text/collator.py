@@ -115,7 +115,11 @@ class RandomTruncateCollator(Collator):
 class WordMaskingCollator(Collator):
     """Whole-word masking with the 80/10/10 split: of the randomly selected words,
     80% become mask tokens, 10% random tokens, 10% unchanged. Examples must carry
-    ``word_ids`` (token -> word index or None)."""
+    ``word_ids`` (token -> word index or None).
+
+    When the native C library is built (python -m perceiver_io_tpu.native.build)
+    the per-token inner loop runs in C; the Python path is the fallback and the
+    behavioral specification."""
 
     def __init__(
         self,
@@ -124,14 +128,38 @@ class WordMaskingCollator(Collator):
         pad_token_id: int,
         mask_prob: float = 0.15,
         rng: Optional[np.random.Generator] = None,
+        use_native: bool = True,
     ):
         self.mask_token_id = mask_token_id
         self.vocab_size = vocab_size
         self.pad_token_id = pad_token_id
         self.mask_prob = mask_prob
         self.rng = rng if rng is not None else np.random.default_rng()
+        self._native_fn = None
+        if use_native:
+            from perceiver_io_tpu.native import mask_words_native, native_available
+
+            if native_available():
+                self._native_fn = mask_words_native
 
     def mask_words(self, example: dict) -> dict:
+        if self._native_fn is not None:
+            wids = np.asarray(
+                [-1 if w is None else int(w) for w in example["word_ids"]], dtype=np.int64
+            )
+            ids, labels = self._native_fn(
+                np.asarray(example["input_ids"], np.int64),
+                wids,
+                self.mask_prob,
+                self.mask_token_id,
+                self.vocab_size,
+                seed=int(self.rng.integers(2**63)),
+                ignore_index=IGNORE,
+            )
+            return {"input_ids": ids, "labels": labels}
+        return self._mask_words_py(example)
+
+    def _mask_words_py(self, example: dict) -> dict:
         word_ids = example["word_ids"]
         input_ids = list(example["input_ids"])
         labels = [IGNORE] * len(input_ids)

@@ -273,6 +273,9 @@ class TextDataModule:
         tok = self._tokenizer
         if self.add_eos_token:
             text = text + (tok.eos_token if isinstance(tok.eos_token, str) else "")
+        if not with_word_ids and hasattr(tok, "encode_array"):
+            # vectorized corpus-preparation fast path (ByteTokenizer)
+            return tok.encode_array(text, self.add_special_tokens), None
         ids = tok.encode(text, self.add_special_tokens)
         if not with_word_ids:
             return ids, None

@@ -49,6 +49,14 @@ class ByteTokenizer:
             ids = [self.cls_token_id] + ids + [self.sep_token_id]
         return ids
 
+    def encode_array(self, text: str, add_special_tokens: bool = False) -> np.ndarray:
+        """Vectorized encode (the corpus-preparation fast path)."""
+        ids = np.frombuffer(text.encode("utf-8", errors="replace"), dtype=np.uint8).astype(np.int32)
+        ids = ids + _BYTE_OFFSET
+        if add_special_tokens:
+            ids = np.concatenate(([self.cls_token_id], ids, [self.sep_token_id])).astype(np.int32)
+        return ids
+
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         data = bytes(i - _BYTE_OFFSET for i in ids if i >= _BYTE_OFFSET)
         text = data.decode("utf-8", errors="replace")

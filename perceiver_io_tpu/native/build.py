@@ -1,0 +1,24 @@
+"""Build the native shared library: ``python -m perceiver_io_tpu.native.build``."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def build(verbose: bool = True) -> str:
+    here = os.path.dirname(__file__)
+    out = os.path.join(here, "libperceiver_native.so")
+    sources = [os.path.join(here, "wordmask.c")]
+    cmd = [os.environ.get("CC", "cc"), "-O3", "-fPIC", "-shared", "-o", out, *sources]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.exit(0)

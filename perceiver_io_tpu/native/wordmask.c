@@ -1,0 +1,87 @@
+/* Whole-word masking hot loop (C implementation).
+ *
+ * Implements the same 80/10/10 whole-word masking as
+ * perceiver_io_tpu/data/text/collator.py::WordMaskingCollator.mask_words
+ * (reference: perceiver/data/text/collator.py:87-144): words are selected with
+ * probability mask_prob; all tokens of a selected word get their label set to
+ * the original token and are then (per word) replaced by the mask token with
+ * p=0.8, by a random token with p=0.1, or left unchanged with p=0.1.
+ *
+ * This is the per-batch dynamic-masking hot path of MLM training on TPU hosts
+ * (one of the few CPU-bound inner loops in the framework); the Python
+ * implementation walks token lists per example. Exposed via ctypes with the
+ * Python implementation as fallback (see perceiver_io_tpu/native/__init__.py).
+ *
+ * RNG: xorshift64* seeded per call — deterministic given (seed), matching the
+ * testability (not the exact stream) of the numpy Generator used in Python.
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+
+static inline uint64_t xorshift64star(uint64_t *state) {
+    uint64_t x = *state;
+    x ^= x >> 12;
+    x ^= x << 25;
+    x ^= x >> 27;
+    *state = x;
+    return x * 0x2545F4914F6CDD1DULL;
+}
+
+static inline double rand_unit(uint64_t *state) {
+    return (double)(xorshift64star(state) >> 11) / 9007199254740992.0; /* 2^53 */
+}
+
+/* input_ids:  (n,) int64, modified in place with masks applied
+ * word_ids:   (n,) int64, -1 marks special tokens (no word)
+ * labels:     (n,) int64 out, prefilled by caller with ignore_index
+ * Returns the number of masked tokens. */
+long mask_words(
+    int64_t *input_ids,
+    const int64_t *word_ids,
+    int64_t *labels,
+    long n,
+    double mask_prob,
+    int64_t mask_token_id,
+    int64_t vocab_size,
+    uint64_t seed
+) {
+    uint64_t state = seed ? seed : 0x9E3779B97F4A7C15ULL;
+    /* warm up the state so small seeds diverge */
+    xorshift64star(&state);
+    xorshift64star(&state);
+
+    long masked = 0;
+    long i = 0;
+    int64_t current_word_id = -2; /* sentinel: differs from any word id and -1 */
+    int word_selected = 0;
+    double word_roll0 = 0.0, word_roll1 = 0.0;
+
+    for (i = 0; i < n; i++) {
+        int64_t wid = word_ids[i];
+        if (wid < 0) {
+            /* special token: never masked. Does NOT reset the current word —
+             * a word id reappearing after a special token continues the same
+             * word and shares its fate (matches the Python specification). */
+            continue;
+        }
+        if (wid != current_word_id) { /* new word: draw its fate */
+            current_word_id = wid;
+            word_selected = rand_unit(&state) < mask_prob;
+            if (word_selected) {
+                word_roll0 = rand_unit(&state);
+                word_roll1 = rand_unit(&state);
+            }
+        }
+        if (!word_selected) continue;
+
+        labels[i] = input_ids[i];
+        masked++;
+        if (word_roll0 < 0.8) {
+            input_ids[i] = mask_token_id;
+        } else if (word_roll1 < 0.5) {
+            input_ids[i] = (int64_t)(xorshift64star(&state) % (uint64_t)vocab_size);
+        } /* else: leave unchanged */
+    }
+    return masked;
+}

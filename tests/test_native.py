@@ -1,0 +1,103 @@
+"""Native C fast-path tests: build the library, check statistical equivalence
+with the Python whole-word-masking specification, determinism, and fallback."""
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.text.collator import IGNORE, WordMaskingCollator
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def built_lib():
+    from perceiver_io_tpu.native.build import build
+
+    build(verbose=False)
+    import perceiver_io_tpu.native as native
+
+    native._load_attempted = False  # force reload after (re)build
+    native._lib = None
+    assert native.native_available()
+    return native
+
+
+def _stats(ids, labels, orig, mask_token_id):
+    masked = labels != IGNORE
+    rate = masked.mean()
+    mask_frac = (ids[masked] == mask_token_id).mean()
+    keep_frac = (ids[masked] == orig[masked]).mean()
+    return rate, mask_frac, keep_frac
+
+
+def test_native_masking_statistics(built_lib):
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(0)
+    text = "word " * 2000
+    orig = np.asarray(tok.encode(text), np.int64)
+    wids = np.asarray([-1 if w is None else w for w in tok.word_ids(orig.tolist())], np.int64)
+
+    ids, labels = built_lib.mask_words_native(
+        orig, wids, mask_prob=0.15, mask_token_id=tok.mask_token_id, vocab_size=tok.vocab_size, seed=42
+    )
+    rate, mask_frac, keep_frac = _stats(ids, labels, orig, tok.mask_token_id)
+    assert 0.10 < rate < 0.20           # ~ mask_prob
+    assert 0.70 < mask_frac < 0.90      # ~80% mask tokens
+    assert 0.03 < keep_frac < 0.25      # ~10% kept + random collisions
+    # unmasked tokens untouched
+    np.testing.assert_array_equal(ids[labels == IGNORE], orig[labels == IGNORE])
+
+
+def test_native_is_deterministic_per_seed(built_lib):
+    tok = ByteTokenizer()
+    orig = np.asarray(tok.encode("alpha beta gamma " * 50), np.int64)
+    wids = np.asarray(tok.word_ids(orig.tolist()), np.int64)
+    a = built_lib.mask_words_native(orig, wids, 0.15, tok.mask_token_id, tok.vocab_size, seed=7)
+    b = built_lib.mask_words_native(orig, wids, 0.15, tok.mask_token_id, tok.vocab_size, seed=7)
+    c = built_lib.mask_words_native(orig, wids, 0.15, tok.mask_token_id, tok.vocab_size, seed=8)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_collator_native_vs_python_equivalent_statistics(built_lib):
+    tok = ByteTokenizer()
+    text = "some words to mask here " * 200
+    ids = tok.encode(text)
+    example = {"input_ids": list(ids), "word_ids": tok.word_ids(ids)}
+
+    def run(use_native):
+        coll = WordMaskingCollator(
+            tok.mask_token_id, tok.vocab_size, tok.pad_token_id,
+            mask_prob=0.15, rng=np.random.default_rng(0), use_native=use_native,
+        )
+        labels, out_ids, _ = coll([dict(example, input_ids=list(ids))])
+        return _stats(out_ids[0], labels[0], np.asarray(ids), tok.mask_token_id)
+
+    r_native = run(True)
+    r_python = run(False)
+    for a, b in zip(r_native, r_python):
+        assert abs(a - b) < 0.08  # same masking distribution, different RNG streams
+
+
+def test_whole_words_masked_together(built_lib):
+    tok = ByteTokenizer()
+    ids = tok.encode("abcdefgh ijklmnop " * 100)  # long words: word-level behavior visible
+    wids_list = tok.word_ids(ids)
+    ids_arr = np.asarray(ids, np.int64)
+    wids = np.asarray(wids_list, np.int64)
+    out, labels = built_lib.mask_words_native(ids_arr, wids, 0.3, tok.mask_token_id, tok.vocab_size, seed=3)
+    # every selected word is masked in full: label coverage is constant within a word run
+    masked = labels != IGNORE
+    runs = {}
+    for pos, w in enumerate(wids_list):
+        runs.setdefault(w, []).append(bool(masked[pos]))
+    partial = [w for w, flags in runs.items() if any(flags) and not all(flags)]
+    assert partial == []
+
+
+def test_byte_tokenizer_encode_array_matches_encode():
+    tok = ByteTokenizer()
+    text = "héllo wörld! " * 10
+    np.testing.assert_array_equal(tok.encode_array(text), np.asarray(tok.encode(text)))
+    np.testing.assert_array_equal(
+        tok.encode_array(text, add_special_tokens=True), np.asarray(tok.encode(text, add_special_tokens=True))
+    )
