@@ -37,6 +37,18 @@ from perceiver_io_tpu.models.core.perceiver_ar import (
     PerceiverAR,
     PerceiverARCache,
 )
+from perceiver_io_tpu.generation.generate import GenerationConfig, generate
+from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.text.common import TextEncoderConfig
+from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, MaskedLanguageModelConfig
+from perceiver_io_tpu.models.vision.image_classifier import (
+    ImageClassifier,
+    ImageClassifierConfig,
+    ImageEncoderConfig,
+)
+from perceiver_io_tpu.models.vision.optical_flow import OpticalFlow, OpticalFlowConfig
 from perceiver_io_tpu.ops.attention import KVCache, MultiHeadAttention
 from perceiver_io_tpu.ops.position import (
     RotaryPositionEmbedding,
@@ -44,5 +56,6 @@ from perceiver_io_tpu.ops.position import (
     frequency_position_encoding,
     positions,
 )
+from perceiver_io_tpu.pipelines import OpticalFlowPipeline, SymbolicAudioPipeline, TextGenerationPipeline
 
 __version__ = "0.1.0"

@@ -17,9 +17,12 @@ from perceiver_io_tpu.data.loader import DataLoader
 
 def mnist_transform(
     images: np.ndarray, normalize: bool = True, channels_last: bool = True,
-    random_crop: Optional[int] = None, rng: Optional[np.random.Generator] = None,
+    random_crop: Optional[int] = None, center_crop: Optional[int] = None,
+    rng: Optional[np.random.Generator] = None,
 ) -> np.ndarray:
-    """(B, 28, 28) uint8 -> float32 with the reference's transform stack."""
+    """(B, 28, 28) uint8 -> float32 with the reference's transform stack.
+    ``center_crop`` is the deterministic eval-side counterpart of the
+    ``random_crop`` train augmentation, so train and eval shapes agree."""
     x = images.astype(np.float32) / 255.0
     if random_crop is not None:
         rng = rng if rng is not None else np.random.default_rng()
@@ -30,6 +33,10 @@ def mnist_transform(
             left = int(rng.integers(0, w - random_crop + 1))
             out[i] = x[i, top : top + random_crop, left : left + random_crop]
         x = out
+    elif center_crop is not None:
+        b, h, w = x.shape
+        top, left = (h - center_crop) // 2, (w - center_crop) // 2
+        x = x[:, top : top + center_crop, left : left + center_crop]
     if normalize:
         x = (x - 0.5) / 0.5
     return x[..., None] if channels_last else x[:, None]
@@ -89,7 +96,7 @@ class MNISTDataModule:
         tr_images, tr_labels = self._load("train")
         va_images, va_labels = self._load("test")
         tf_train = lambda im: mnist_transform(im, self.normalize, self.channels_last, self.random_crop, self._rng)
-        tf_valid = lambda im: mnist_transform(im, self.normalize, self.channels_last, None)
+        tf_valid = lambda im: mnist_transform(im, self.normalize, self.channels_last, None, center_crop=self.random_crop)
         self.ds_train = _MnistSplit(tr_images, tr_labels, tf_train)
         self.ds_valid = _MnistSplit(va_images, va_labels, tf_valid)
 

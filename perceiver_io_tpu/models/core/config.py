@@ -83,6 +83,13 @@ class PerceiverARConfig:
     self_attention_widening_factor: int = 4
     cross_attention_widening_factor: int = 4
     cross_attention_dropout: float = 0.5
+    # "gather" (default): the reference's exact fixed-size random-subset gather
+    #   (modules.py:814-826) — also the fastest on TPU, since halving the prefix
+    #   halves the cross-attention kv projections and scores (measured 176.6k
+    #   vs 140.4k tok/s at p=0.5 on v5e).
+    # "mask": Bernoulli drop via the attention mask — no sort/gather; useful when
+    #   the kept count must stay shape-static across dropout rates.
+    cross_attention_dropout_mode: str = "gather"
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False

@@ -334,6 +334,9 @@ class SelfAttentionBlock(nn.Module):
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
+    scan_unroll: int = 1  # lax.scan unroll factor for the layer loop; measured
+    # NOT beneficial on v5e for the Perceiver AR stack (scan 176.6k vs unroll=8
+    # 159.4k tok/s) — exposed for other shapes/generations
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -377,6 +380,7 @@ class SelfAttentionBlock(nn.Module):
             in_axes=(0, 0, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=self.num_layers,
+            unroll=min(self.scan_unroll, self.num_layers),
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(
             num_heads=self.num_heads,

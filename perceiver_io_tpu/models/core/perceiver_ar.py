@@ -93,6 +93,7 @@ class PerceiverAR(nn.Module):
     self_attention_widening_factor: int = 4
     cross_attention_widening_factor: int = 4
     cross_attention_dropout: float = 0.5
+    cross_attention_dropout_mode: str = "gather"  # "gather" (reference-exact, fastest) | "mask"
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
@@ -169,20 +170,39 @@ class PerceiverAR(nn.Module):
         pad_prefix = None if pad_mask is None else pad_mask[:, :prefix_len]
 
         if (not self.deterministic) and prefix_len > 0 and self.cross_attention_dropout > 0.0:
-            # Cross-attention (prefix) dropout: keep a static-count random subset of
-            # prefix positions, order-preserving (reference modules.py:809-830).
-            keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
-            rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
-            _, keep_idx = jax.lax.top_k(rand, keep)
-            keep_idx = jnp.sort(keep_idx, axis=1)
-            x_prefix = jnp.take_along_axis(x_prefix, keep_idx[..., None], axis=1)
-            frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
-            if pad_prefix is not None:
-                pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
+            if self.cross_attention_dropout_mode == "mask":
+                # Bernoulli drop of prefix positions expressed through the attention
+                # pad mask: no sort/gather, shapes stay static and flash-compatible.
+                # Subset-size variance vs the reference's fixed-count subset is
+                # negligible (std ~ sqrt(p(1-p)n), <2% of the keep count at n=3584).
+                dropped = jax.random.bernoulli(
+                    self.make_rng("dropout"), self.cross_attention_dropout, (b, prefix_len)
+                )
+                pad_prefix = dropped if pad_prefix is None else (pad_prefix | dropped)
+            elif self.cross_attention_dropout_mode == "gather":
+                # Reference-exact: keep a static-count random subset of prefix
+                # positions, order-preserving (reference modules.py:809-830).
+                keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
+                rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+                _, keep_idx = jax.lax.top_k(rand, keep)
+                keep_idx = jnp.sort(keep_idx, axis=1)
+                x_prefix = jnp.take_along_axis(x_prefix, keep_idx[..., None], axis=1)
+                frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
+                if pad_prefix is not None:
+                    pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
+            else:
+                raise ValueError(
+                    f"unknown cross_attention_dropout_mode '{self.cross_attention_dropout_mode}'"
+                )
 
         rope_q = frq_latent
         rope_k = jnp.concatenate([frq_prefix, frq_latent], axis=1)
-        pad_full = None if pad_mask is None else jnp.concatenate([pad_prefix, pad_latent], axis=1)
+        if pad_prefix is None and pad_latent is None:
+            pad_full = None
+        else:
+            pp = pad_prefix if pad_prefix is not None else jnp.zeros((b, x_prefix.shape[1]), bool)
+            pl = pad_latent if pad_latent is not None else jnp.zeros((b, n - prefix_len), bool)
+            pad_full = jnp.concatenate([pp, pl], axis=1)
 
         x_latent, _ = self.cross_attention(
             x_latent, x_kv_prefix=x_prefix, pad_mask=pad_full, rope_q=rope_q, rope_k=rope_k
@@ -338,6 +358,7 @@ class CausalSequenceModel(nn.Module):
             self_attention_widening_factor=cfg.self_attention_widening_factor,
             cross_attention_widening_factor=cfg.cross_attention_widening_factor,
             cross_attention_dropout=cfg.cross_attention_dropout,
+            cross_attention_dropout_mode=cfg.cross_attention_dropout_mode,
             post_attention_dropout=cfg.post_attention_dropout,
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,

@@ -38,6 +38,7 @@ def main(argv=None):
     cli.add_group("optimizer", OptimizerFlags, dict(lr=1e-4, warmup_steps=100, schedule="constant"))
     cli.add_group("trainer", TrainerConfig, dict(max_steps=10000, checkpoint_dir="ckpts/txt_clf", monitor="acc", monitor_mode="max"))
     cli.add_flag("mlm_checkpoint", help="orbax checkpoint dir of a trained MLM for encoder warm start")
+    cli.add_flag("resume_checkpoint", help="orbax checkpoint dir of a stage-1 classifier run to fine-tune from")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -61,6 +62,14 @@ def main(argv=None):
         from perceiver_io_tpu.scripts.common import load_encoder_params
 
         params = load_encoder_params(args.mlm_checkpoint, params)
+    if args.resume_checkpoint:
+        # full warm start from a previous classifier run (stage-2 fine-tuning)
+        import jax as _jax
+
+        from perceiver_io_tpu.training.checkpoint import load_pytree
+
+        tree = load_pytree(args.resume_checkpoint)
+        params = _jax.tree.map(jnp.asarray, tree.get("params", tree))
     print(json.dumps({"model_params": sum(p.size for p in jax.tree.leaves(params))}))
 
     tx = build_tx(opt, trainer_cfg.max_steps)
