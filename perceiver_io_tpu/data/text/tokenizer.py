@@ -21,6 +21,11 @@ import numpy as np
 
 _SPECIAL_TOKENS = ["[PAD]", "[BOS]", "[EOS]", "[MASK]", "[CLS]", "[SEP]"]
 _BYTE_OFFSET = len(_SPECIAL_TOKENS)  # 6
+_SPECIAL_TOKEN_IDS = {tok: i for i, tok in enumerate(_SPECIAL_TOKENS)}
+
+import re  # noqa: E402
+
+_SPECIAL_SPLIT = re.compile("(" + "|".join(re.escape(t) for t in _SPECIAL_TOKENS) + ")")
 
 
 class ByteTokenizer:
@@ -44,26 +49,49 @@ class ByteTokenizer:
         self._whitespace_ids = {b + _BYTE_OFFSET for b in string.whitespace.encode("utf-8")}
 
     def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
-        ids = [b + _BYTE_OFFSET for b in text.encode("utf-8", errors="replace")]
+        ids: List[int] = []
+        for part in _SPECIAL_SPLIT.split(text):
+            if part in _SPECIAL_TOKEN_IDS:  # literal "[MASK]" etc. -> special id
+                ids.append(_SPECIAL_TOKEN_IDS[part])
+            else:
+                ids.extend(b + _BYTE_OFFSET for b in part.encode("utf-8", errors="replace"))
         if add_special_tokens:
             ids = [self.cls_token_id] + ids + [self.sep_token_id]
         return ids
 
     def encode_array(self, text: str, add_special_tokens: bool = False) -> np.ndarray:
-        """Vectorized encode (the corpus-preparation fast path)."""
-        ids = np.frombuffer(text.encode("utf-8", errors="replace"), dtype=np.uint8).astype(np.int32)
-        ids = ids + _BYTE_OFFSET
+        """Vectorized encode (the corpus-preparation fast path). Parses literal
+        special-token strings exactly like ``encode`` so both paths agree."""
+        parts = []
+        for part in _SPECIAL_SPLIT.split(text):
+            if part in _SPECIAL_TOKEN_IDS:
+                parts.append(np.asarray([_SPECIAL_TOKEN_IDS[part]], np.int32))
+            elif part:
+                raw = np.frombuffer(part.encode("utf-8", errors="replace"), dtype=np.uint8)
+                parts.append(raw.astype(np.int32) + _BYTE_OFFSET)
+        ids = np.concatenate(parts) if parts else np.zeros(0, np.int32)
         if add_special_tokens:
             ids = np.concatenate(([self.cls_token_id], ids, [self.sep_token_id])).astype(np.int32)
         return ids
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
-        data = bytes(i - _BYTE_OFFSET for i in ids if i >= _BYTE_OFFSET)
-        text = data.decode("utf-8", errors="replace")
-        if not skip_special_tokens:
-            specials = "".join(_SPECIAL_TOKENS[i] for i in ids if i < _BYTE_OFFSET)
-            return specials + text if specials else text
-        return text
+        if skip_special_tokens:
+            data = bytes(i - _BYTE_OFFSET for i in ids if i >= _BYTE_OFFSET)
+            return data.decode("utf-8", errors="replace")
+        # preserve special-token positions by decoding byte runs between them
+        parts: List[str] = []
+        run: List[int] = []
+        for i in ids:
+            if i < _BYTE_OFFSET:
+                if run:
+                    parts.append(bytes(run).decode("utf-8", errors="replace"))
+                    run = []
+                parts.append(_SPECIAL_TOKENS[i])
+            else:
+                run.append(i - _BYTE_OFFSET)
+        if run:
+            parts.append(bytes(run).decode("utf-8", errors="replace"))
+        return "".join(parts)
 
     def __call__(self, texts, add_special_tokens: bool = False, **_):
         if isinstance(texts, str):

@@ -1,0 +1,51 @@
+"""Fill-mask inference utility.
+
+Parity target: /root/reference/perceiver/model/text/mlm/utils.py ``MaskFiller``
+(used by the MLM Lightning wrapper's per-eval qualitative sample logging,
+text/mlm/lightning.py:77-94): replace ``<mask>`` spans in text, run the model,
+and return the top-k predictions per masked batch entry.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MaskFiller:
+    """``preprocessor`` is a TextPreprocessor (tokenizer + max_seq_len)."""
+
+    def __init__(self, preprocessor):
+        self.preprocessor = preprocessor
+
+    def fill(
+        self,
+        apply_fn: Callable[[jax.Array, jax.Array], jax.Array],
+        masked_text_batch: Sequence[str],
+        num_predictions: int,
+    ) -> Tuple[List[str], List[List[str]]]:
+        """``apply_fn(input_ids, pad_mask) -> logits`` (e.g.
+        ``lambda x, m: model.apply(params, x, pad_mask=m)``). Returns the
+        mask-substituted input texts and, per input, ``num_predictions`` filled
+        variants ranked by the per-position top-k logits."""
+        tokenizer = self.preprocessor.tokenizer
+        mask_token = getattr(tokenizer, "mask_token", "[MASK]")
+        masked_text_batch = [text.replace("<mask>", mask_token) for text in masked_text_batch]
+
+        xs, pad = self.preprocessor.preprocess_batch(masked_text_batch)
+        logits = np.asarray(apply_fn(jnp.asarray(xs), jnp.asarray(pad)))
+
+        pred_mask = xs == tokenizer.mask_token_id
+        masked_logits = logits[pred_mask]  # (num_masked, vocab)
+        pred_ids = np.argsort(-masked_logits, axis=1)[:, :num_predictions]
+
+        results = []
+        filled = xs.copy()
+        for i in range(num_predictions):
+            filled[pred_mask] = pred_ids[:, i]
+            results.append([tokenizer.decode(row, skip_special_tokens=True) for row in filled])
+        # transpose: per-input list of the k filled variants
+        return masked_text_batch, list(map(list, zip(*results)))

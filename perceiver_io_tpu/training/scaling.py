@@ -1,0 +1,65 @@
+"""Chinchilla-style scaling-law fitting for Perceiver AR.
+
+Parity target: /root/reference/examples/scaling/clm/scaling/laws.py (power-law
+fits of compute-optimal parameter and token counts) — here scipy-free: with the
+exponent fixed, the LINEAR-space least-squares coefficient has a closed form
+(the same objective the reference's scipy curve_fit minimizes, so fits match;
+note linear-space residuals weight the largest-compute runs most heavily).
+
+Combined with ``training.flops.PerceiverARFlops``, this reproduces the
+reference's scaling-study workflow (examples/scaling/clm): estimate training
+FLOPs per run, fit N_opt = k_n * C^a and D_opt = k_d * C^b across IsoFLOP runs,
+and size the next model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclass
+class ScalingLaw:
+    a: float
+    b: float
+    k_n: float
+    k_d: float
+
+    def n_opt(self, flops) -> np.ndarray:
+        """Compute-optimal parameter count at a FLOPs budget."""
+        return self.k_n * np.asarray(flops, float) ** self.a
+
+    def d_opt(self, flops) -> np.ndarray:
+        """Compute-optimal training-token count at a FLOPs budget."""
+        return self.k_d * np.asarray(flops, float) ** self.b
+
+    def __str__(self):
+        return f"N_opt = {self.k_n:.4f} * C ** {self.a:.2f}\nD_opt = {self.k_d:.4f} * C ** {self.b:.2f}"
+
+
+def fit_power_law(xs: Sequence[float], ys: Sequence[float], m: float) -> float:
+    """Least-squares fit of k in y = k * x**m (fixed exponent m): the minimizer
+    of sum (y - k x^m)^2 is k = sum(y x^m) / sum(x^2m)."""
+    xs = np.asarray(xs, float) ** m
+    ys = np.asarray(ys, float)
+    return float((ys * xs).sum() / (xs * xs).sum())
+
+
+def fit_scaling_law(
+    flops_arr: Sequence[float],
+    params_arr: Sequence[float],
+    tokens_arr: Sequence[float],
+    a: float = 0.5,
+    b: float = 0.5,
+) -> ScalingLaw:
+    """Fit compute-optimal coefficients from observed (FLOPs, params, tokens)
+    triples of IsoFLOP-optimal runs; ``a``/``b`` are the assumed exponents
+    (0.5/0.5 = Chinchilla Approach-2 defaults)."""
+    return ScalingLaw(
+        a=a,
+        b=b,
+        k_n=fit_power_law(flops_arr, params_arr, m=a),
+        k_d=fit_power_law(flops_arr, tokens_arr, m=b),
+    )

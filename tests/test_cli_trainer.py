@@ -114,3 +114,16 @@ def test_task_clis_parse_help():
         with pytest.raises(SystemExit) as e:
             module.main(argv=["--help"])
         assert e.value.code == 0
+
+
+def test_scaling_law_fit_recovers_coefficients():
+    from perceiver_io_tpu.training.scaling import fit_scaling_law
+
+    flops = np.array([1e18, 1e19, 1e20, 1e21])
+    law_true_kn, law_true_kd = 0.3, 1.7
+    params = law_true_kn * flops**0.5
+    tokens = law_true_kd * flops**0.5
+    law = fit_scaling_law(flops, params, tokens)
+    np.testing.assert_allclose(law.k_n, law_true_kn, rtol=1e-6)
+    np.testing.assert_allclose(law.k_d, law_true_kd, rtol=1e-6)
+    np.testing.assert_allclose(law.n_opt(4e20), law_true_kn * 2e10, rtol=1e-6)
