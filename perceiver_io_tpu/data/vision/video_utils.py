@@ -1,0 +1,68 @@
+"""Video IO helpers for the optical-flow pipeline.
+
+Parity target: /root/reference/perceiver/data/vision/video_utils.py (OpenCV
+frame reading / frame-pair iteration / mp4 writing). cv2 is not part of this
+image, so it is imported lazily; every function raises a clear error when it is
+unavailable rather than at import time.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+
+def _cv2():
+    try:
+        import cv2  # noqa: PLC0415
+
+        return cv2
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("video utilities require opencv-python (cv2)") from e
+
+
+def read_video_frames(video_path: str | Path) -> Iterator[np.ndarray]:
+    """Yield RGB frames (H, W, 3) uint8 from a video file."""
+    cv2 = _cv2()
+    if not Path(video_path).exists():
+        raise ValueError(f"Path '{video_path}' does not exist")
+    capture = cv2.VideoCapture(str(video_path))
+    if not capture.isOpened():
+        capture.release()
+        raise ValueError(f"Could not open video '{video_path}'")
+    try:
+        while True:
+            ok, frame = capture.read()
+            if not ok:
+                break
+            yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+    finally:
+        capture.release()
+
+
+def read_video_frame_pairs(video_path: str | Path) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield consecutive (frame_t, frame_t+1) RGB pairs — optical-flow input."""
+    prev = None
+    for frame in read_video_frames(video_path):
+        if prev is not None:
+            yield prev, frame
+        prev = frame
+
+
+def write_video(video_path: str | Path, frames: List[np.ndarray], fps: int = 30) -> None:
+    """Write RGB uint8 frames to an mp4 file."""
+    cv2 = _cv2()
+    if not frames:
+        raise ValueError("no frames to write")
+    h, w = frames[0].shape[:2]
+    writer = cv2.VideoWriter(str(video_path), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    if not writer.isOpened():
+        writer.release()
+        raise ValueError(f"Could not open video writer for '{video_path}'")
+    try:
+        for frame in frames:
+            writer.write(cv2.cvtColor(frame, cv2.COLOR_RGB2BGR))
+    finally:
+        writer.release()

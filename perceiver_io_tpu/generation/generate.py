@@ -40,6 +40,7 @@ class GenerationConfig:
     top_p: Optional[float] = None
     num_beams: int = 1
     length_penalty: float = 1.0
+    penalty_alpha: Optional[float] = None  # with top_k > 1: contrastive search
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
 
@@ -161,6 +162,72 @@ def _generate_beam(model, params, input_ids, pad_mask, rng, *, prefix_len: int, 
     return jnp.concatenate([input_ids, best_tokens], axis=1)
 
 
+@partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
+def _generate_contrastive(model, params, input_ids, pad_mask, rng, *, prefix_len: int, config: GenerationConfig):
+    """Contrastive search (https://arxiv.org/abs/2202.06417), the remaining HF
+    sampling mode the reference exercises (tests/causal_language_model_pipeline_test.py):
+    at each step the top-k candidate tokens are scored by
+    (1 - alpha) * p(candidate) - alpha * max cosine-similarity(candidate hidden,
+    previous hidden states); k model evaluations per generated token."""
+    b, seq_len = input_ids.shape
+    k = config.top_k
+    alpha = config.penalty_alpha
+
+    cache = model.init_cache(batch_size=b, dtype=_cache_dtype(model))
+    logits, hidden, cache = model.apply(
+        params, input_ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill_with_hidden
+    )
+    next_logits = logits[:, -1]
+    n_hist0 = hidden.shape[1]
+
+    # hidden-state history for the degeneration penalty (prompt latents + generated)
+    hist_cap = n_hist0 + config.max_new_tokens
+    history = jnp.zeros((b, hist_cap, hidden.shape[-1]), hidden.dtype).at[:, :n_hist0].set(hidden)
+    eos = config.eos_token_id
+    finished0 = jnp.zeros((b,), bool)
+
+    def body(carry, step):
+        cache, next_logits, history, n_hist, finished = carry
+        probs = jax.nn.softmax(next_logits, axis=-1)
+        top_p, top_ids = jax.lax.top_k(probs, k)  # (b, k)
+
+        # evaluate all k candidates: expand the cache to b*k branches
+        expand = jnp.repeat(jnp.arange(b), k)
+        cache_k = reorder_cache(cache, expand)
+        cand_tokens = top_ids.reshape(-1, 1).astype(input_ids.dtype)
+        logits_k, hidden_k, cache_k = model.apply(
+            params, cand_tokens, cache_k, method=type(model).decode_step_with_hidden
+        )
+        h_cand = hidden_k[:, -1].reshape(b, k, -1)  # (b, k, c)
+
+        # degeneration penalty: max cosine similarity against valid history rows
+        h_norm = h_cand / (jnp.linalg.norm(h_cand, axis=-1, keepdims=True) + 1e-8)
+        hist_norm = history / (jnp.linalg.norm(history, axis=-1, keepdims=True) + 1e-8)
+        sims = jnp.einsum("bkc,bhc->bkh", h_norm, hist_norm)
+        valid = jnp.arange(hist_cap)[None, None, :] < n_hist
+        sims = jnp.where(valid, sims, -jnp.inf)
+        penalty = sims.max(-1)  # (b, k)
+
+        score = (1.0 - alpha) * top_p - alpha * penalty
+        best = score.argmax(axis=1)  # (b,)
+        tok = jnp.take_along_axis(top_ids, best[:, None], axis=1)[:, 0]
+        if eos is not None:
+            tok = jnp.where(finished, config.pad_token_id, tok)
+            finished = finished | (tok == eos)
+
+        sel = jnp.arange(b) * k + best
+        cache = reorder_cache(cache_k, sel)
+        next_logits = logits_k[:, -1].reshape(b, k, -1)[jnp.arange(b), best]
+        h_sel = h_cand[jnp.arange(b), best]
+        history = jax.lax.dynamic_update_slice_in_dim(history, h_sel[:, None], n_hist, axis=1)
+        return (cache, next_logits, history, n_hist + 1, finished), tok
+
+    (_, _, _, _, _), tokens = jax.lax.scan(
+        body, (cache, next_logits, history, jnp.asarray(n_hist0), finished0), jnp.arange(config.max_new_tokens)
+    )
+    return jnp.concatenate([input_ids, tokens.T.astype(input_ids.dtype)], axis=1)
+
+
 def generate(
     model,
     params,
@@ -184,6 +251,12 @@ def generate(
     prefix_len = _validate(model, input_ids.shape[1], num_latents)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if config.penalty_alpha is not None and config.penalty_alpha > 0:
+        if not config.top_k or config.top_k < 2:
+            raise ValueError("contrastive search requires top_k >= 2 with penalty_alpha")
+        if config.do_sample or config.num_beams > 1:
+            raise ValueError("penalty_alpha (contrastive search) is incompatible with do_sample/num_beams")
+        return _generate_contrastive(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
     if config.num_beams > 1:
         if config.do_sample:
             raise ValueError("beam-multinomial sampling (num_beams > 1 with do_sample) is not supported yet")

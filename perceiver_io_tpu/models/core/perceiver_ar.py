@@ -405,14 +405,30 @@ class CausalSequenceModel(nn.Module):
             batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers, cfg.num_channels, dtype
         )
 
-    def prefill(
+    def prefill_with_hidden(
         self, x: jax.Array, prefix_len: int, cache: PerceiverARCache, pad_mask: Optional[jax.Array] = None
-    ) -> Tuple[jax.Array, PerceiverARCache]:
+    ) -> Tuple[jax.Array, jax.Array, PerceiverARCache]:
+        """prefill returning (logits, pre-head hidden, cache) — the single
+        implementation; the hidden states feed contrastive search's penalty."""
         if prefix_len > self.max_prefix_len:
             raise ValueError(f"prefix_len ({prefix_len}) exceeds max_prefix_len ({self.max_prefix_len})")
         hidden, cache = self.ar.prefill(x, prefix_len=prefix_len, cache=cache, pad_mask=pad_mask)
-        return self._head(hidden), cache
+        return self._head(hidden), hidden, cache
+
+    def prefill(
+        self, x: jax.Array, prefix_len: int, cache: PerceiverARCache, pad_mask: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, PerceiverARCache]:
+        logits, _, cache = self.prefill_with_hidden(x, prefix_len, cache, pad_mask)
+        return logits, cache
+
+    def decode_step_with_hidden(
+        self, x: jax.Array, cache: PerceiverARCache
+    ) -> Tuple[jax.Array, jax.Array, PerceiverARCache]:
+        """decode_step returning (logits, pre-head hidden, cache) — the single
+        implementation."""
+        hidden, cache = self.ar.decode_step(x, cache)
+        return self._head(hidden), hidden, cache
 
     def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
-        hidden, cache = self.ar.decode_step(x, cache)
-        return self._head(hidden), cache
+        logits, _, cache = self.decode_step_with_hidden(x, cache)
+        return logits, cache

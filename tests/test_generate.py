@@ -177,3 +177,38 @@ def test_eos_stops_and_pads(setup):
     )
     after = np.asarray(out[0, 10:])
     assert (after == 0).all()  # everything after EOS is pad
+
+
+def test_contrastive_search(setup):
+    model, params, x = setup
+    prompt = x[:, :8]
+    out = generate(
+        model, params, prompt, num_latents=4,
+        config=GenerationConfig(max_new_tokens=6, top_k=4, penalty_alpha=0.6),
+    )
+    assert out.shape == (2, 14)
+    np.testing.assert_array_equal(np.asarray(out[:, :8]), np.asarray(prompt))
+    # alpha=0 must reduce exactly to greedy (penalty term vanishes; top-1 prob wins)
+    greedy = generate(model, params, prompt, num_latents=4, max_new_tokens=6)
+    almost_greedy = generate(
+        model, params, prompt, num_latents=4,
+        config=GenerationConfig(max_new_tokens=6, top_k=4, penalty_alpha=1e-9),
+    )
+    np.testing.assert_array_equal(np.asarray(almost_greedy), np.asarray(greedy))
+    # a dominant penalty (alpha ~ 1: pure anti-similarity selection) must deviate
+    # from greedy somewhere across prompts/steps
+    anti = generate(
+        model, params, prompt, num_latents=4,
+        config=GenerationConfig(max_new_tokens=6, top_k=4, penalty_alpha=0.99),
+    )
+    assert not np.array_equal(np.asarray(anti), np.asarray(greedy))
+
+
+def test_contrastive_validation(setup):
+    model, params, x = setup
+    with pytest.raises(ValueError, match="top_k >= 2"):
+        generate(model, params, x[:, :8], num_latents=4,
+                 config=GenerationConfig(max_new_tokens=3, penalty_alpha=0.5))
+    with pytest.raises(ValueError, match="incompatible"):
+        generate(model, params, x[:, :8], num_latents=4,
+                 config=GenerationConfig(max_new_tokens=3, penalty_alpha=0.5, top_k=4, do_sample=True))
