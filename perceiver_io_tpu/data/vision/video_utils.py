@@ -24,7 +24,8 @@ def _cv2():
 
 
 def read_video_frames(video_path: str | Path) -> Iterator[np.ndarray]:
-    """Yield RGB frames (H, W, 3) uint8 from a video file."""
+    """Yield RGB frames (H, W, 3) uint8 from a video file. Path and open
+    failures raise at CALL time (not first iteration)."""
     cv2 = _cv2()
     if not Path(video_path).exists():
         raise ValueError(f"Path '{video_path}' does not exist")
@@ -32,14 +33,18 @@ def read_video_frames(video_path: str | Path) -> Iterator[np.ndarray]:
     if not capture.isOpened():
         capture.release()
         raise ValueError(f"Could not open video '{video_path}'")
-    try:
-        while True:
-            ok, frame = capture.read()
-            if not ok:
-                break
-            yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
-    finally:
-        capture.release()
+
+    def frames() -> Iterator[np.ndarray]:
+        try:
+            while True:
+                ok, frame = capture.read()
+                if not ok:
+                    break
+                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+        finally:
+            capture.release()
+
+    return frames()
 
 
 def read_video_frame_pairs(video_path: str | Path) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -54,6 +59,8 @@ def read_video_frame_pairs(video_path: str | Path) -> Iterator[Tuple[np.ndarray,
 def write_video(video_path: str | Path, frames: List[np.ndarray], fps: int = 30) -> None:
     """Write RGB uint8 frames to an mp4 file."""
     cv2 = _cv2()
+    if Path(video_path).suffix.lower() != ".mp4":
+        raise ValueError("Only files of type 'mp4' are supported")
     if not frames:
         raise ValueError("no frames to write")
     h, w = frames[0].shape[:2]

@@ -256,6 +256,8 @@ def generate(
             raise ValueError("contrastive search requires top_k >= 2 with penalty_alpha")
         if config.do_sample or config.num_beams > 1:
             raise ValueError("penalty_alpha (contrastive search) is incompatible with do_sample/num_beams")
+        if config.temperature != 1.0 or (config.top_p is not None and config.top_p < 1.0):
+            raise ValueError("temperature/top_p have no effect in contrastive search; leave them at defaults")
         return _generate_contrastive(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
     if config.num_beams > 1:
         if config.do_sample:

@@ -94,6 +94,9 @@ class PerceiverARConfig:
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
     activation_offloading: bool = False
+    # mesh axis name for sequence-parallel ring attention over the prefix/latent
+    # sequences (long-context training beyond one chip's memory); None = off
+    sequence_parallel_axis: Optional[str] = None
 
     def base_kwargs(self, exclude=()):
         return _base_kwargs(self, PerceiverARConfig, exclude)

@@ -85,6 +85,7 @@ class CrossAttention(nn.Module):
     qkv_bias: bool = True
     out_bias: bool = True
     init_scale: float = 0.02
+    seq_axis: Optional[str] = None
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -105,6 +106,7 @@ class CrossAttention(nn.Module):
             qkv_bias=self.qkv_bias,
             out_bias=self.out_bias,
             kernel_init_scale=self.init_scale,
+            seq_axis=self.seq_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -143,6 +145,7 @@ class SelfAttention(nn.Module):
     qkv_bias: bool = True
     out_bias: bool = True
     init_scale: float = 0.02
+    seq_axis: Optional[str] = None
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -161,6 +164,7 @@ class SelfAttention(nn.Module):
             qkv_bias=self.qkv_bias,
             out_bias=self.out_bias,
             kernel_init_scale=self.init_scale,
+            seq_axis=self.seq_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -195,6 +199,7 @@ class CrossAttentionLayer(nn.Module):
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
+    seq_axis: Optional[str] = None
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -212,6 +217,7 @@ class CrossAttentionLayer(nn.Module):
             qkv_bias=self.qkv_bias,
             out_bias=self.out_bias,
             init_scale=self.init_scale,
+            seq_axis=self.seq_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -261,6 +267,7 @@ class SelfAttentionLayer(nn.Module):
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
+    seq_axis: Optional[str] = None
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -277,6 +284,7 @@ class SelfAttentionLayer(nn.Module):
             qkv_bias=self.qkv_bias,
             out_bias=self.out_bias,
             init_scale=self.init_scale,
+            seq_axis=self.seq_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -334,6 +342,7 @@ class SelfAttentionBlock(nn.Module):
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
+    seq_axis: Optional[str] = None
     scan_unroll: int = 1  # lax.scan unroll factor for the layer loop; measured
     # NOT beneficial on v5e for the Perceiver AR stack (scan 176.6k vs unroll=8
     # 159.4k tok/s) — exposed for other shapes/generations
@@ -396,6 +405,7 @@ class SelfAttentionBlock(nn.Module):
             out_bias=self.out_bias,
             mlp_bias=self.mlp_bias,
             init_scale=self.init_scale,
+            seq_axis=self.seq_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

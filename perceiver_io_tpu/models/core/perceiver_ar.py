@@ -98,6 +98,7 @@ class PerceiverAR(nn.Module):
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
     init_scale: float = 0.02
+    sequence_parallel_axis: Optional[str] = None  # mesh axis for ring attention (long context)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -117,6 +118,7 @@ class PerceiverAR(nn.Module):
             out_bias=True,
             mlp_bias=False,
             init_scale=self.init_scale,
+            seq_axis=self.sequence_parallel_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -136,6 +138,7 @@ class PerceiverAR(nn.Module):
             out_bias=False,
             mlp_bias=False,
             init_scale=self.init_scale,
+            seq_axis=self.sequence_parallel_axis,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -360,6 +363,7 @@ class CausalSequenceModel(nn.Module):
             cross_attention_dropout=cfg.cross_attention_dropout,
             cross_attention_dropout_mode=cfg.cross_attention_dropout_mode,
             post_attention_dropout=cfg.post_attention_dropout,
+            sequence_parallel_axis=cfg.sequence_parallel_axis,
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,
             init_scale=cfg.init_scale,

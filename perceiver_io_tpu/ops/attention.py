@@ -120,6 +120,7 @@ class MultiHeadAttention(nn.Module):
     out_bias: bool = True
     kernel_init_scale: float = 0.02
     use_flash: Optional[bool] = None  # None = auto (TPU + supported shapes)
+    seq_axis: Optional[str] = None  # sequence-parallel ring attention over this mesh axis
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -191,12 +192,25 @@ class MultiHeadAttention(nn.Module):
         if rope_k is not None:
             k = apply_rope(k, rope_k)
 
+        has_dropout = self.dropout > 0.0 and not self.deterministic
+
+        # Sequence-parallel path: ring attention over the configured mesh axis
+        # (long-context training; queries and keys sharded over `seq`).
+        if self.seq_axis is not None and kv_cache is None:
+            if has_dropout:
+                raise ValueError("attention dropout is not supported on the ring-attention path")
+            from perceiver_io_tpu.parallel.ring_attention import ring_attention_ambient
+
+            if q.shape[0] != k.shape[0]:
+                q = jnp.broadcast_to(q, (k.shape[0], *q.shape[1:]))
+            o = ring_attention_ambient(q, k, v, pad_mask=pad_mask, causal=self.causal_attention, seq_axis=self.seq_axis)
+            o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
+            return self.o_proj(o), kv_cache
+
         # TPU fast path: fused splash (flash) attention — no materialized
         # (Nq, Nk) matrix. Falls through to the XLA formulation when unsupported
         # (caches, attention dropout, mismatched qk/v head widths, odd shapes).
         from perceiver_io_tpu.ops.flash import flash_supported, splash_mha
-
-        has_dropout = self.dropout > 0.0 and not self.deterministic
         flash_ok = flash_supported(
             num_qk // self.num_heads, num_v // self.num_heads, n_q, n_k, has_dropout, kv_cache is not None
         )

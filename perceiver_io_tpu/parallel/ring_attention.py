@@ -41,14 +41,13 @@ def _ring_attention_local(q, k, v, pad, *, axis_name: str, vary_axes, nq_total: 
 
     # accumulators must carry the same varying-axis type as the rotating KV
     # shards for the fori_loop carry (jax.shard_map tracks per-axis variance)
-    m0, l0, o0 = jax.lax.pvary(
-        (
-            jnp.full((b, h, nq, 1), -jnp.inf, jnp.float32),
-            jnp.zeros((b, h, nq, 1), jnp.float32),
-            jnp.zeros((b, h, nq, d), jnp.float32),
-        ),
-        vary_axes,
+    init = (
+        jnp.full((b, h, nq, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, nq, 1), jnp.float32),
+        jnp.zeros((b, h, nq, d), jnp.float32),
     )
+    _pcast = getattr(jax.lax, "pcast", None)
+    m0, l0, o0 = _pcast(init, vary_axes, to="varying") if _pcast else jax.lax.pvary(init, vary_axes)
 
     # right-aligned GLOBAL positions of this device's query rows
     q_pos = nk_total - nq_total + me * nq + jnp.arange(nq)
@@ -94,7 +93,7 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    mesh: Mesh,
+    mesh: Optional[Mesh] = None,
     pad_mask: Optional[jax.Array] = None,
     causal: bool = True,
     seq_axis: str = "seq",
@@ -108,21 +107,34 @@ def ring_attention(
     k/v (B, H, Nk, D) — keys/values with Nk sharded over ``seq``.
     pad_mask (B, Nk) True = padding.
     causal: right-aligned causal masking (the Perceiver AR convention).
+    mesh: explicit mesh, or None to use the ambient one
+        (``jax.sharding.set_mesh`` — the form model modules use).
     """
     try:
         from jax import shard_map  # JAX >= 0.8
     except ImportError:  # pragma: no cover - older JAX
         from jax.experimental.shard_map import shard_map
 
+    if mesh is not None:
+        axis_names = mesh.axis_names
+    else:
+        abstract_mesh = jax.sharding.get_abstract_mesh()
+        axis_names = (abstract_mesh.axis_names or ()) if abstract_mesh is not None else ()
+    if seq_axis not in axis_names:
+        raise ValueError(
+            f"ring attention requires an active mesh with a '{seq_axis}' axis "
+            "(pass mesh= or wrap the computation in jax.sharding.set_mesh(mesh))"
+        )
+
     if pad_mask is None:
         pad_mask = jnp.zeros(k.shape[:1] + k.shape[2:3], bool)
 
-    baxes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    baxes = tuple(a for a in batch_axes if a in axis_names)
     bspec = baxes if baxes else None
-    q_spec = P(bspec, None, seq_axis, None)
-    kv_spec = P(bspec, None, seq_axis, None)
+    qkv_spec = P(bspec, None, seq_axis, None)
     pad_spec = P(bspec, seq_axis)
 
+    kwargs = {} if mesh is None else {"mesh": mesh}
     fn = shard_map(
         partial(
             _ring_attention_local,
@@ -132,8 +144,23 @@ def ring_attention(
             nk_total=k.shape[2],
             causal=causal,
         ),
-        mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, pad_spec),
-        out_specs=q_spec,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pad_spec),
+        out_specs=qkv_spec,
+        **kwargs,
     )
     return fn(q, k, v, pad_mask)
+
+
+def ring_attention_ambient(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pad_mask: Optional[jax.Array] = None,
+    causal: bool = True,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+) -> jax.Array:
+    """Alias: ring_attention with the ambient mesh."""
+    return ring_attention(
+        q, k, v, mesh=None, pad_mask=pad_mask, causal=causal, seq_axis=seq_axis, batch_axes=batch_axes
+    )

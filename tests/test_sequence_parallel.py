@@ -1,0 +1,84 @@
+"""Sequence-parallel Perceiver AR: the full model forward/backward with ring
+attention over a `seq` mesh axis must match the single-device computation —
+long-context capability the torch reference has no analog for."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.parallel.mesh import make_mesh
+
+BASE = dict(
+    vocab_size=64,
+    max_seq_len=32,
+    max_latents=16,  # latents divisible by the seq axis size
+    num_channels=32,
+    num_heads=4,
+    num_self_attention_layers=2,
+    cross_attention_dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    plain = CausalSequenceModel(config=CausalSequenceModelConfig(**BASE))
+    seqp = CausalSequenceModel(config=CausalSequenceModelConfig(**BASE, sequence_parallel_axis="seq"))
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (2, 32), 0, 64)
+    params = jax.jit(plain.init, static_argnames="prefix_len")(rng, x, prefix_len=16)
+    return plain, seqp, params, x
+
+
+@pytest.mark.parametrize("axes", [{"seq": 4}, {"data": 2, "seq": 4}])
+def test_sequence_parallel_forward_matches(setup, axes):
+    plain, seqp, params, x = setup
+    ref = plain.apply(params, x, prefix_len=16)
+    n = int(np.prod(list(axes.values())))
+    mesh = make_mesh(axes, devices=jax.devices()[:n])
+    with jax.sharding.set_mesh(mesh):
+        out = jax.jit(lambda p, x: seqp.apply(p, x, prefix_len=16))(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_sequence_parallel_train_gradients_match(setup):
+    plain, seqp, params, x = setup
+    labels = jnp.roll(x, -1, axis=1)[:, 16:]
+
+    def loss(model):
+        def f(p):
+            logits = model.apply(p, x, prefix_len=16)
+            import optax
+
+            return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+        return f
+
+    g_ref = jax.jit(jax.grad(loss(plain)))(params)
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    with jax.sharding.set_mesh(mesh):
+        g_seq = jax.jit(jax.grad(loss(seqp)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5), g_ref, g_seq
+    )
+
+
+def test_sequence_parallel_requires_mesh(setup):
+    _, seqp, params, x = setup
+    with pytest.raises(ValueError, match="requires an active mesh"):
+        seqp.apply(params, x, prefix_len=16)
+
+
+def test_sequence_parallel_decode_falls_back(setup):
+    """Cached decode ignores the seq axis (single-token steps are not
+    sequence-parallel) and must still work under the mesh context."""
+    plain, seqp, params, x = setup
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    cache = seqp.init_cache(batch_size=2)
+    with jax.sharding.set_mesh(mesh):
+        logits, cache = seqp.apply(params, x[:, :24], 8, cache, method=CausalSequenceModel.prefill)
+    ref_cache = plain.init_cache(batch_size=2)
+    ref_logits, _ = plain.apply(params, x[:, :24], 8, ref_cache, method=CausalSequenceModel.prefill)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), atol=2e-5)
