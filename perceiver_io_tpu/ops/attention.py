@@ -212,7 +212,14 @@ class MultiHeadAttention(nn.Module):
         # (caches, attention dropout, mismatched qk/v head widths, odd shapes).
         from perceiver_io_tpu.ops.flash import flash_supported, splash_mha
         flash_ok = flash_supported(
-            num_qk // self.num_heads, num_v // self.num_heads, n_q, n_k, has_dropout, kv_cache is not None
+            num_qk // self.num_heads,
+            num_v // self.num_heads,
+            n_q,
+            n_k,
+            has_dropout,
+            kv_cache is not None,
+            batch_size=k.shape[0],
+            num_heads=self.num_heads,
         )
         if self.use_flash is True and not flash_ok:
             raise ValueError(

@@ -12,11 +12,13 @@ Padding is expressed through segment ids (pad kv tokens get segment 0, real
 tokens 1; all queries are real in the paths that use this — Perceiver AR latents
 are the sequence suffix).
 
-Known limitation (tracked for the next round): under a multi-chip SPMD mesh the
-pallas call is not auto-partitioned by XLA; multi-chip runs should wrap it in
-shard_map over the head/batch axes. Single-chip jit (the bench path) is the
-supported configuration today; CPU test runs fall back to the XLA formulation
-via ``flash_supported``.
+Multi-chip: the pallas call is not auto-partitioned by XLA SPMD, so under an
+active mesh (``jax.sharding.set_mesh``) the kernel runs inside ``shard_map``
+over the batch (``data``/``fsdp``) and head (``tensor``) axes — each device runs
+splash on its local shard with no extra communication. Meshes with other
+sharded axes (e.g. ``seq``) fall back to the XLA formulation (the model's
+ring-attention path owns sequence parallelism). CPU test runs fall back via
+``flash_supported`` (or use interpret mode explicitly).
 """
 
 from __future__ import annotations
@@ -30,6 +32,30 @@ import jax.numpy as jnp
 
 _BLOCK = 256
 _DISABLE_ENV = "PERCEIVER_IO_TPU_DISABLE_FLASH"
+_BATCH_AXES = ("data", "fsdp")
+_HEAD_AXIS = "tensor"
+
+
+def _mesh_plan():
+    """(batch_axes, head_axis_or_None, b_shards, h_shards) when the ambient
+    mesh's sharded axes are all batch/head-mappable; None otherwise (no mesh,
+    or axes like 'seq' that this wrapper cannot map)."""
+    import numpy as np
+
+    if jax.device_count() == 1:
+        return ((), None, 1, 1)
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return None
+    sizes = dict(mesh.shape)
+    for name, size in sizes.items():
+        if size > 1 and name not in (*_BATCH_AXES, _HEAD_AXIS):
+            return None
+    baxes = tuple(a for a in _BATCH_AXES if sizes.get(a, 1) > 1)
+    head = _HEAD_AXIS if sizes.get(_HEAD_AXIS, 1) > 1 else None
+    b_shards = int(np.prod([sizes[a] for a in baxes])) if baxes else 1
+    h_shards = sizes.get(head, 1) if head else 1
+    return (baxes, head, b_shards, h_shards)
 
 
 def flash_supported(
@@ -39,6 +65,8 @@ def flash_supported(
     n_k: int,
     has_dropout: bool,
     has_cache: bool,
+    batch_size: Optional[int] = None,
+    num_heads: Optional[int] = None,
 ) -> bool:
     """Static predicate: can the splash kernel serve this attention call?"""
     if os.environ.get(_DISABLE_ENV, "").lower() not in ("", "0", "false"):
@@ -48,9 +76,17 @@ def flash_supported(
     if jax.default_backend() != "tpu":
         return False
     if jax.device_count() > 1:
-        # the pallas call is not auto-partitioned by XLA SPMD; multi-chip meshes
-        # need the shard_map wrapper (tracked) — fall back rather than break
-        return False
+        plan = _mesh_plan()
+        if plan is None:
+            # multi-chip needs the shard_map wrapper, which needs an ambient
+            # mesh whose axes we know how to map (batch/head); else fall back
+            return False
+        _, _, b_shards, h_shards = plan
+        if batch_size is None or num_heads is None:
+            # without shapes we cannot certify divisibility on a mesh
+            return b_shards == 1 and h_shards == 1
+        if batch_size % b_shards != 0 or num_heads % h_shards != 0:
+            return False
     if num_qk_channels_per_head != num_v_channels_per_head:
         return False  # splash assumes one head_dim for q/k/v
     if num_qk_channels_per_head % 64 != 0:
@@ -99,8 +135,12 @@ def splash_mha(
 
     b, h, n_q, _ = q.shape
     n_k = k.shape[2]
-    kernel = _kernel(h, n_q, n_k, causal, interpret)
 
+    plan = _mesh_plan()
+    if plan is not None and (plan[0] or plan[1]):
+        return _splash_mha_sharded(q, k, v, pad_mask, causal, interpret, plan)
+
+    kernel = _kernel(h, n_q, n_k, causal, interpret)
     if pad_mask is None:
         return jax.vmap(kernel)(q, k, v)
 
@@ -109,3 +149,51 @@ def splash_mha(
     return jax.vmap(lambda q, k, v, sq, skv: kernel(q, k, v, segment_ids=sa.SegmentIds(sq, skv)))(
         q, k, v, seg_q, seg_kv
     )
+
+
+def _splash_mha_sharded(q, k, v, pad_mask, causal, interpret, plan):
+    """Run splash per-device inside shard_map: batch sharded over data/fsdp,
+    heads over tensor — embarrassingly parallel, no collectives."""
+    import jax.experimental.pallas.ops.tpu.splash_attention as sa
+    from jax.sharding import PartitionSpec as P
+
+    # the new-style jax.shard_map is required here (check_vma semantics); the
+    # legacy experimental API is not signature-compatible with these calls
+    from jax import shard_map
+
+    baxes, head_axis, b_shards, h_shards = plan
+    b, h, n_q, _ = q.shape
+    n_k = k.shape[2]
+    if b % b_shards or h % h_shards:
+        raise ValueError(  # flash_supported should have routed this away
+            f"splash shard_map needs batch {b} % {b_shards} == 0 and heads {h} % {h_shards} == 0"
+        )
+    kernel = _kernel(h // h_shards, n_q, n_k, causal, interpret)
+
+    bspec = baxes if baxes else None
+    qkv_spec = P(bspec, head_axis, None, None)
+    pad_spec = P(bspec, None)
+
+    if pad_mask is None:
+        fn = shard_map(
+            lambda q, k, v: jax.vmap(kernel)(q, k, v),
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    def local(q, k, v, pad):
+        seg_q = jnp.ones((q.shape[0], n_q), jnp.int32)
+        seg_kv = jnp.where(pad, 0, 1).astype(jnp.int32)
+        return jax.vmap(lambda q, k, v, sq, skv: kernel(q, k, v, segment_ids=sa.SegmentIds(sq, skv)))(
+            q, k, v, seg_q, seg_kv
+        )
+
+    fn = shard_map(
+        local,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pad_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, pad_mask)

@@ -37,21 +37,38 @@ def shard_train_state(state, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_s
     return sharded, state_sh
 
 
+def _with_mesh_context(fn: Callable, mesh: Mesh) -> Callable:
+    """Run (and trace) ``fn`` under the ambient mesh so mesh-aware fast paths
+    (e.g. the shard_map splash-attention wrapper) can see the axes."""
+
+    def wrapped(*args, **kwargs):
+        with jax.sharding.set_mesh(mesh):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 def make_sharded_train_step(train_step: Callable, mesh: Mesh, state_sh) -> Callable:
     """jit the (state, batch) -> (state, metrics) step with the batch sharded over
     the data axes, the state donated (in-place buffer reuse on device), and
     metrics replicated."""
-    return jax.jit(
-        train_step,
-        in_shardings=(state_sh, batch_sharding(mesh)),
-        out_shardings=(state_sh, replicated(mesh)),
-        donate_argnums=(0,),
+    return _with_mesh_context(
+        jax.jit(
+            train_step,
+            in_shardings=(state_sh, batch_sharding(mesh)),
+            out_shardings=(state_sh, replicated(mesh)),
+            donate_argnums=(0,),
+        ),
+        mesh,
     )
 
 
 def make_sharded_eval_step(eval_step: Callable, mesh: Mesh, param_sh) -> Callable:
-    return jax.jit(
-        eval_step,
-        in_shardings=(param_sh, batch_sharding(mesh)),
-        out_shardings=replicated(mesh),
+    return _with_mesh_context(
+        jax.jit(
+            eval_step,
+            in_shardings=(param_sh, batch_sharding(mesh)),
+            out_shardings=replicated(mesh),
+        ),
+        mesh,
     )
