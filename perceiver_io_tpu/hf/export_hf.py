@@ -1,0 +1,149 @@
+"""Export flax-trained params INTO official HF ``transformers`` Perceiver models
+— the inverse of ``convert_hf`` and the counterpart of the reference's
+``convert_checkpoint`` utilities (Lightning ckpt -> HF save_pretrained dir,
+e.g. reference text/clm/huggingface.py:57-65): train on TPU here, publish into
+the HF ecosystem.
+
+Currently supports the MaskedLanguageModel -> PerceiverForMaskedLM direction
+(the reference's primary published-checkpoint family); the mapping tables are
+shared with convert_hf, transposed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+
+def _to_torch(x):
+    import torch
+
+    import numpy as np
+
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+def _set_dense(sd: Dict, prefix: str, tree: Mapping):
+    sd[f"{prefix}.weight"] = _to_torch(tree["kernel"]).T.contiguous()
+    if "bias" in tree:
+        sd[f"{prefix}.bias"] = _to_torch(tree["bias"])
+
+
+def _set_ln(sd: Dict, prefix: str, tree: Mapping):
+    sd[f"{prefix}.weight"] = _to_torch(tree["scale"])
+    sd[f"{prefix}.bias"] = _to_torch(tree["bias"])
+
+
+def _set_attention(sd: Dict, prefix: str, tree: Mapping):
+    _set_dense(sd, f"{prefix}.attention.self.query", tree["q_proj"])
+    _set_dense(sd, f"{prefix}.attention.self.key", tree["k_proj"])
+    _set_dense(sd, f"{prefix}.attention.self.value", tree["v_proj"])
+    _set_dense(sd, f"{prefix}.attention.output.dense", tree["o_proj"])
+
+
+def _set_mlp(sd: Dict, prefix: str, tree: Mapping):
+    _set_ln(sd, f"{prefix}.layernorm", tree["norm"])
+    _set_dense(sd, f"{prefix}.mlp.dense1", tree["dense_1"])
+    _set_dense(sd, f"{prefix}.mlp.dense2", tree["dense_2"])
+
+
+def _set_cross_attention_layer(sd: Dict, prefix: str, tree: Mapping):
+    ca = tree["cross_attn"]
+    _set_ln(sd, f"{prefix}.attention.self.layernorm1", ca["q_norm"])
+    _set_ln(sd, f"{prefix}.attention.self.layernorm2", ca["kv_norm"])
+    _set_attention(sd, prefix, ca["attention"])
+    _set_mlp(sd, prefix, tree["mlp"])
+
+
+def _set_self_attention_block(sd: Dict, prefix: str, layers: Mapping, num_layers: int):
+    for i in range(num_layers):
+        layer = jax_tree_index(layers, i)
+        sa = layer["self_attn"]
+        _set_ln(sd, f"{prefix}.{i}.attention.self.layernorm1", sa["norm"])
+        _set_attention(sd, f"{prefix}.{i}", sa["attention"])
+        _set_mlp(sd, f"{prefix}.{i}", layer["mlp"])
+
+
+def jax_tree_index(tree, i: int):
+    import jax
+
+    return jax.tree.map(lambda x: x[i], tree)
+
+
+def masked_language_model_to_hf(config, params) -> "object":
+    """Build a transformers.PerceiverForMaskedLM carrying these flax params.
+    ``config``: MaskedLanguageModelConfig (tied decoder); ``params``: the flax
+    param tree. Returns the torch model (call ``.save_pretrained(dir)`` on it)."""
+    import transformers
+
+    enc = config.encoder
+    dec = config.decoder
+    if dec.num_output_query_channels is not None:
+        raise ValueError("only tied-head MLMs map onto PerceiverForMaskedLM")
+    # transformers' MLM decoder hardcodes qk=256, heads=8, v=d_model,
+    # use_query_residual=False (convert_hf.py documents the same resolution);
+    # exporting any other decoder would silently change the computation
+    if (
+        dec.cross_attention_residual
+        or dec.num_cross_attention_heads != 8
+        or dec.num_cross_attention_qk_channels != 256
+        or dec.num_cross_attention_v_channels not in (None, enc.num_input_channels)
+    ):
+        raise ValueError(
+            "decoder config does not match transformers' hardcoded MLM decoder "
+            "(requires cross_attention_residual=False, heads=8, qk_channels=256, "
+            "v_channels=d_model)"
+        )
+    # HF encoders repeat ONE weight-shared block; unshared repeats and repeated
+    # cross-attention have no HF equivalent
+    if enc.num_cross_attention_layers != 1:
+        raise ValueError("repeated cross-attention (num_cross_attention_layers > 1) cannot map onto HF Perceiver")
+    if enc.num_self_attention_blocks > 1 and not enc.first_self_attention_block_shared:
+        raise ValueError("unshared self-attention blocks cannot map onto HF Perceiver (blocks are weight-shared)")
+    hf_config = transformers.PerceiverConfig(
+        vocab_size=enc.vocab_size,
+        max_position_embeddings=enc.max_seq_len,
+        d_model=enc.num_input_channels,
+        d_latents=config.num_latent_channels,
+        num_latents=config.num_latents,
+        num_blocks=enc.num_self_attention_blocks,
+        num_self_attends_per_block=enc.num_self_attention_layers_per_block,
+        num_self_attention_heads=enc.num_self_attention_heads,
+        num_cross_attention_heads=enc.num_cross_attention_heads,
+        qk_channels=enc.num_cross_attention_qk_channels,
+        v_channels=enc.num_cross_attention_v_channels,
+        cross_attention_widening_factor=enc.cross_attention_widening_factor,
+        self_attention_widening_factor=enc.self_attention_widening_factor,
+        attention_probs_dropout_prob=enc.dropout,
+        initializer_range=enc.init_scale,
+    )
+    model = transformers.PerceiverForMaskedLM(hf_config)
+
+    p = params["params"]
+    sd = dict(model.state_dict())
+    encoder = p["encoder"]
+    sd["perceiver.input_preprocessor.embeddings.weight"] = _to_torch(
+        encoder["input_adapter"]["txt_embedding"]["embedding"]
+    )
+    sd["perceiver.input_preprocessor.position_embeddings.weight"] = _to_torch(
+        encoder["input_adapter"]["pos_embedding"]["embedding"]
+    )
+    sd["perceiver.embeddings.latents"] = _to_torch(encoder["latent_provider"]["query"])
+    _set_cross_attention_layer(sd, "perceiver.encoder.cross_attention", encoder["cross_attn_1"])
+    _set_self_attention_block(
+        sd, "perceiver.encoder.self_attends", encoder["self_attn_1"]["layers"], enc.num_self_attention_layers_per_block
+    )
+    decoder = p["decoder"]
+    sd["perceiver.decoder.output_position_encodings.position_embeddings"] = _to_torch(
+        decoder["output_query_provider"]["query"]
+    )
+    _set_cross_attention_layer(sd, "perceiver.decoder.decoding_cross_attention", decoder["cross_attn"])
+    sd["embedding_decoder.bias"] = _to_torch(p["tied_bias"]["bias"])
+
+    model.load_state_dict(sd)
+    return model
+
+
+def export_masked_language_model(config, params, save_dir: str) -> None:
+    """One-call export: flax MLM -> HF save_pretrained directory."""
+    model = masked_language_model_to_hf(config, params)
+    model.save_pretrained(save_dir)

@@ -44,3 +44,49 @@ def restore_checkpoint(path: str, template: Any, shardings: Optional[Any] = None
     else:
         targets = jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
     return _checkpointer().restore(path, targets)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoint history with retention and best-tracking —
+    orbax CheckpointManager with the reference's ModelCheckpoint semantics
+    (monitor metric + mode, reference scripts/trainer.yaml:7-12) plus retention
+    the reference never had. With ``monitor`` set, retention keeps the
+    ``max_to_keep`` BEST checkpoints (orbax best_fn semantics) — the most recent
+    non-best checkpoint is not guaranteed to survive.
+
+    >>> mgr = CheckpointManager(dir, max_to_keep=3, monitor="loss", mode="min")
+    >>> mgr.save(step, state, metrics={"loss": 1.2})
+    >>> state = mgr.restore_latest(state_template)
+    >>> state = mgr.restore_best(state_template)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3, monitor: Optional[str] = None, mode: str = "min"):
+        self._monitor = monitor
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            best_fn=(lambda metrics: metrics[monitor]) if monitor else None,
+            best_mode=mode,
+        )
+        self._mgr = ocp.CheckpointManager(os.path.abspath(os.fspath(directory)), options=options)
+
+    def save(self, step: int, state: Any, metrics: Optional[dict] = None) -> None:
+        self._mgr.save(int(step), args=ocp.args.StandardSave(state), metrics=metrics)
+        self._mgr.wait_until_finished()
+
+    def _restore(self, step: Optional[int], template: Any) -> Any:
+        targets = jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(targets))
+
+    def restore_latest(self, template: Any) -> Any:
+        return self._restore(self._mgr.latest_step(), template)
+
+    def restore_best(self, template: Any) -> Any:
+        if self._monitor is None:
+            raise ValueError("restore_best requires a monitor metric (orbax would silently return the latest)")
+        return self._restore(self._mgr.best_step(), template)
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -127,3 +127,22 @@ def test_scaling_law_fit_recovers_coefficients():
     np.testing.assert_allclose(law.k_n, law_true_kn, rtol=1e-6)
     np.testing.assert_allclose(law.k_d, law_true_kd, rtol=1e-6)
     np.testing.assert_allclose(law.n_opt(4e20), law_true_kn * 2e10, rtol=1e-6)
+
+
+def test_checkpoint_manager_retention_and_best(tmp_path):
+    from perceiver_io_tpu.training.checkpoint import CheckpointManager
+
+    state = {"w": jnp.arange(4.0), "step": jnp.zeros((), jnp.int32)}
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, monitor="loss", mode="min")
+    losses = {1: 3.0, 2: 1.0, 3: 2.0, 4: 2.5}
+    for step, loss in losses.items():
+        mgr.save(step, {"w": jnp.arange(4.0) + step, "step": jnp.asarray(step, jnp.int32)}, metrics={"loss": loss})
+    # with a monitor metric, retention keeps the N best checkpoints
+    kept = mgr.all_steps()
+    assert sorted(kept) == [2, 3]  # losses 1.0 and 2.0 survive; 3.0/2.5 dropped
+    latest = mgr.restore_latest(state)
+    assert int(latest["step"]) == 3  # latest retained step
+    best = mgr.restore_best(state)
+    assert int(best["step"]) == 2
+    np.testing.assert_allclose(np.asarray(best["w"]), np.arange(4.0) + 2)
+    mgr.close()

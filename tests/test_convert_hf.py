@@ -123,3 +123,27 @@ def test_optical_flow_logit_parity_tiny():
         ref = hf(torch.tensor(x)).logits.numpy()
     out = np.asarray(model.apply(params, jnp.asarray(x)))
     np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_mlm_export_roundtrip():
+    """flax -> HF export must be the exact inverse of HF -> flax conversion:
+    the exported torch model reproduces the flax logits."""
+    from perceiver_io_tpu.hf.export_hf import masked_language_model_to_hf
+    from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel
+
+    hf_src = transformers.PerceiverForMaskedLM(tiny_perceiver_config()).eval()
+    config, params = masked_language_model_from_hf(hf_src)
+    model = MaskedLanguageModel(config=config)
+    x = np.random.RandomState(5).randint(0, 50, (2, 9))
+    flax_logits = np.asarray(model.apply(params, jnp.asarray(x)))
+
+    hf_exported = masked_language_model_to_hf(config, params).eval()
+    with torch.no_grad():
+        hf_logits = hf_exported(torch.tensor(x)).logits.numpy()
+    np.testing.assert_allclose(flax_logits, hf_logits[:, : flax_logits.shape[1]], atol=ATOL)
+    # full circle: converting the exported model back must give identical params
+    config2, params2 = masked_language_model_from_hf(hf_exported)
+    for (p1, a), (p2, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params), jax.tree_util.tree_leaves_with_path(params2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

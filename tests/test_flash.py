@@ -68,10 +68,6 @@ def test_flash_supported_predicate():
 def test_sharded_splash_matches_xla_on_mesh(qkv):
     """Multi-chip path: splash inside shard_map over data x tensor axes
     (interpret mode on the CPU mesh) must match the XLA reference."""
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
     from perceiver_io_tpu.parallel.mesh import make_mesh
     from perceiver_io_tpu.ops import flash
 
