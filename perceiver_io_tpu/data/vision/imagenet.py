@@ -1,0 +1,60 @@
+"""ImageNet validation preprocessing.
+
+Parity target: /root/reference/perceiver/data/vision/imagenet.py
+(``ImageNetPreprocessor`` — HF PerceiverFeatureExtractor's center-crop/resize/
+normalize validation transform) — here numpy-native with PIL only for resizing,
+producing channels-last float inputs for the Fourier image classifier.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def proportional_center_crop(img: np.ndarray, size: int, crop_size: int) -> np.ndarray:
+    """HF PerceiverImageProcessor semantics: crop a SQUARE of side
+    (size / crop_size) * min(h, w) — proportional, never aspect-distorting."""
+    h, w = img.shape[:2]
+    side = max(1, int(round(size / crop_size * min(h, w))))
+    top, left = max(0, (h - side) // 2), max(0, (w - side) // 2)
+    return img[top : top + side, left : left + side]
+
+
+def resize_bicubic(img: np.ndarray, size: int) -> np.ndarray:
+    from PIL import Image
+
+    return np.asarray(Image.fromarray(img).resize((size, size), Image.BICUBIC))
+
+
+def imagenet_valid_transform(
+    img: np.ndarray, crop_size: int = 256, size: int = 224, channels_last: bool = True
+) -> np.ndarray:
+    """(H, W, 3) uint8 -> normalized float32: proportional square center crop
+    (side = size/crop_size * min_dim, the HF PerceiverImageProcessor rule) then
+    bicubic resize to ``size`` (the deepmind/vision-perceiver validation
+    pipeline)."""
+    img = proportional_center_crop(np.asarray(img), size, crop_size)
+    img = resize_bicubic(img, size)
+    x = img.astype(np.float32) / 255.0
+    x = (x - IMAGENET_MEAN) / IMAGENET_STD
+    return x if channels_last else x.transpose(2, 0, 1)
+
+
+class ImageNetPreprocessor:
+    """Batch preprocessing for ImageNet-style inference inputs."""
+
+    def __init__(self, crop_size: int = 256, size: int = 224, channels_last: bool = True):
+        self.crop_size = crop_size
+        self.size = size
+        self.channels_last = channels_last
+
+    def preprocess(self, img: np.ndarray) -> np.ndarray:
+        return imagenet_valid_transform(img, self.crop_size, self.size, self.channels_last)
+
+    def preprocess_batch(self, imgs: Sequence[np.ndarray]) -> np.ndarray:
+        return np.stack([self.preprocess(im) for im in imgs])

@@ -1,0 +1,30 @@
+"""Offline audio-dataset preparation CLI (reference scripts/audio/preproc.py).
+
+  python -m perceiver_io_tpu.scripts.audio.preproc giantmidi --giantmidi.max_seq_len=6144
+"""
+
+from __future__ import annotations
+
+import sys
+
+from perceiver_io_tpu.data.audio.datasets import GiantMidiPianoDataModule, MaestroV3DataModule
+from perceiver_io_tpu.utils.cli import CLI
+
+MODULES = {"giantmidi": GiantMidiPianoDataModule, "maestro-v3": MaestroV3DataModule}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in MODULES:
+        raise SystemExit(f"usage: preproc {{{','.join(MODULES)}}} [--<field>=<value> ...]")
+    name = argv.pop(0)
+    cli = CLI(description=f"Prepare the {name} dataset", argv=argv)
+    cli.add_group(name, MODULES[name], dict(dataset_dir=f".cache/{name}"))
+    args = cli.parse()
+    dm = cli.build(name, args)
+    dm.prepare_data()
+    print(f"prepared -> {dm.preproc_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Model conversion CLI — the reference's examples/convert.py batch driver as a
+command: official DeepMind HF checkpoints -> native orbax params.
+
+  python -m perceiver_io_tpu.scripts.convert deepmind/language-perceiver out/mlm
+
+(torch-reference / Lightning checkpoints need a model config and therefore go
+through the perceiver_io_tpu.hf.convert_torch functions directly — see README.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Convert official HF Perceiver checkpoints to native params")
+    parser.add_argument("source", help="HF repo id (e.g. deepmind/language-perceiver)")
+    parser.add_argument("output_dir", help="directory for the orbax checkpoint + config.json")
+    args = parser.parse_args(argv)
+
+    from perceiver_io_tpu.hf.convert_hf import convert_model
+    from perceiver_io_tpu.training.checkpoint import save_checkpoint
+
+    config, params = convert_model(args.source)
+    os.makedirs(args.output_dir, exist_ok=True)
+    save_checkpoint(os.path.join(args.output_dir, "params"), params)
+    with open(os.path.join(args.output_dir, "config.json"), "w") as f:
+        json.dump(dataclasses.asdict(config), f, indent=2)
+    n = sum(int(p.size) for p in __import__("jax").tree.leaves(params))
+    print(json.dumps({"source": args.source, "params": n, "output": args.output_dir}))
+
+
+if __name__ == "__main__":
+    main()

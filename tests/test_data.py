@@ -304,3 +304,23 @@ def test_dataloader_shuffle_and_batching():
     assert len(loader) == 3 and len(batches) == 3
     seen = [e["x"] for b in batches for e in b]
     assert len(set(seen)) == 9  # drop_last drops one
+
+
+def test_imagenet_preprocessor():
+    from perceiver_io_tpu.data.vision.imagenet import ImageNetPreprocessor
+
+    img = np.random.RandomState(0).randint(0, 255, (300, 400, 3), np.uint8)
+    pre = ImageNetPreprocessor(crop_size=256, size=224)
+    out = pre.preprocess(img)
+    assert out.shape == (224, 224, 3) and out.dtype == np.float32
+    # HF-parity crop: square side = size/crop_size * min_dim (no distortion)
+    from perceiver_io_tpu.data.vision.imagenet import proportional_center_crop
+
+    crop = proportional_center_crop(img, 224, 256)
+    assert crop.shape[0] == crop.shape[1] == int(round(224 / 256 * 300))
+    batch = pre.preprocess_batch([img, img])
+    assert batch.shape == (2, 224, 224, 3)
+    np.testing.assert_allclose(batch[0], batch[1])
+    # channels-first variant
+    cf = ImageNetPreprocessor(channels_last=False).preprocess(img)
+    assert cf.shape == (3, 224, 224)
