@@ -180,8 +180,41 @@ class ChunkFileWriter:
 
 
 def open_chunk_file(path: str, chunk_size: int) -> np.ndarray:
+    if os.path.getsize(path) == 0:  # corpus smaller than one chunk
+        return np.zeros((0, chunk_size), np.int32)
     data = np.memmap(path, dtype=np.int32, mode="r")
     return data.reshape(-1, chunk_size)
+
+
+def _write_token_stream(module, texts, out_dir: str, split: str, with_word_ids: bool, suffix: str = "") -> None:
+    """Tokenize ``texts`` into ``{split}.ids{suffix}.bin`` (+ word-id file)."""
+    ids_writer = ChunkFileWriter(os.path.join(out_dir, f"{split}.ids{suffix}.bin"), module._chunk_size)
+    wid_writer = (
+        ChunkFileWriter(os.path.join(out_dir, f"{split}.wids{suffix}.bin"), module._chunk_size)
+        if with_word_ids
+        else None
+    )
+    for text in texts:
+        ids, wids = module._tokenize_one(text, with_word_ids)
+        ids_writer.write(ids)
+        if wid_writer is not None:
+            wid_writer.write(wids)
+    ids_writer.close()
+    if wid_writer is not None:
+        wid_writer.close()
+
+
+def _tokenize_shard(job):
+    """Worker: re-load the source in-process and tokenize every num_shards-th
+    text starting at shard_idx — texts are never pickled across the process
+    boundary (module-level function for pickling)."""
+    cls, kwargs, out_dir, split, shard_idx, num_shards, with_word_ids = job
+    module = cls(**kwargs)
+    data = module.load_source_dataset()[split]
+    if not isinstance(data, (list, tuple)):
+        data = list(data)
+    _write_token_stream(module, data[shard_idx::num_shards], out_dir, split, with_word_ids, suffix=f".part{shard_idx}")
+    return shard_idx
 
 
 @dataclass
@@ -207,6 +240,7 @@ class TextDataModule:
     random_min_seq_len: int = 16
     batch_size: int = 64
     valid_batch_size_: Optional[int] = None
+    preproc_workers: int = 1  # parallel tokenization shards for prepare_data
     seed: int = 0
 
     def __post_init__(self):
@@ -234,6 +268,10 @@ class TextDataModule:
 
     def preproc_dir_hash_input(self) -> str:
         h = f"{self.tokenizer}-{self.max_seq_len}-{self.task.name}-{self.random_shift}"
+        if self.preproc_workers > 1:
+            # parallel sharding changes chunk boundaries (each shard drops its
+            # own tail) -> different prepared artifact
+            h = f"{h}-w{self.preproc_workers}"
         if self.task == Task.mlm and self.static_masking:
             h = f"{h}-{self.mask_words}-{self.mask_prob}"
         if self.add_special_tokens:
@@ -301,23 +339,54 @@ class TextDataModule:
             )
             return
 
-        # mlm/clm: stream texts into on-disk chunk files (O(chunk) host memory)
         with_word_ids = self.task == Task.mlm
-        ids_writer = ChunkFileWriter(os.path.join(out_dir, f"{split}.ids.bin"), self._chunk_size)
-        wid_writer = (
-            ChunkFileWriter(os.path.join(out_dir, f"{split}.wids.bin"), self._chunk_size) if with_word_ids else None
+        use_parallel = self.preproc_workers > 1 and (
+            not isinstance(data, (list, tuple)) or len(data) >= self.preproc_workers
         )
-        for text in data:
-            ids, wids = self._tokenize_one(text, with_word_ids)
-            ids_writer.write(ids)
-            if wid_writer is not None:
-                wid_writer.write(wids)
-        ids_writer.close()
-        if wid_writer is not None:
-            wid_writer.close()
+        if use_parallel:
+            self._prepare_split_parallel(out_dir, split, with_word_ids)
+        else:
+            _write_token_stream(self, data, out_dir, split, with_word_ids)
 
         if self.task == Task.mlm and self.static_masking:
             self._mask_split(out_dir, split)
+
+    def _prepare_split_parallel(self, out_dir: str, split: str, with_word_ids: bool) -> None:
+        """Tokenize across worker processes (the reference's datasets.map
+        num_proc equivalent, common.py:303-311): each worker re-loads the source
+        itself and streams every num_workers-th text into its own part file
+        (texts never cross the process boundary); parts concatenate in shard
+        order via streaming copies.
+
+        Note: chunk boundaries differ from the serial result (each shard drops
+        its own sub-chunk tail), so the cache key includes the worker count."""
+        import concurrent.futures
+        import multiprocessing
+        import shutil
+
+        jobs = [
+            (type(self), self._prepare_args(), out_dir, split, i, self.preproc_workers, with_word_ids)
+            for i in range(self.preproc_workers)
+        ]
+        # forkserver: forking a JAX-initialized (multi-threaded) parent can
+        # deadlock the children
+        ctx = multiprocessing.get_context("forkserver")
+        with concurrent.futures.ProcessPoolExecutor(max_workers=self.preproc_workers, mp_context=ctx) as pool:
+            list(pool.map(_tokenize_shard, jobs))
+        for suffix in ("ids", "wids") if with_word_ids else ("ids",):
+            target = os.path.join(out_dir, f"{split}.{suffix}.bin")
+            with open(target, "wb") as out:
+                for i in range(self.preproc_workers):
+                    part = os.path.join(out_dir, f"{split}.{suffix}.part{i}.bin")
+                    with open(part, "rb") as f:
+                        shutil.copyfileobj(f, out)
+                    os.remove(part)
+
+    def _prepare_args(self) -> dict:
+        """Constructor kwargs to rebuild an equivalent module in a worker."""
+        import dataclasses
+
+        return {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
 
     def _mask_split(self, out_dir: str, split: str) -> None:
         """Static masking at preparation time (reference common.py:262-263,344-357):
