@@ -30,14 +30,27 @@ def assert_tree_matches(params, template):
     assert a == b, f"\n{a}\nvs\n{b}"
 
 
-def test_causal_sequence_model_conversion():
+@pytest.mark.parametrize(
+    "variant",
+    [
+        # the WikiText CLM flavor (reference examples/training/clm/train.py):
+        dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=1),
+        # the GiantMIDI symbolic-audio flavor (reference examples/training/sam):
+        dict(abs_pos_emb=False, output_norm=True, output_bias=False, num_self_attention_rotary_layers=-1),
+        # the 455M C4 flavor (reference examples/training/clm/train_fsdp.sh):
+        dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=2),
+    ],
+)
+def test_causal_sequence_model_conversion(variant):
+    """Golden conversion across the reference's published config flavors —
+    logits AND exact param-tree structure (no-abs-pos/all-rotary, bias-free
+    heads, output norm)."""
     from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
     from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
 
     kwargs = dict(
         vocab_size=50, max_seq_len=12, max_latents=6, num_channels=16, num_heads=2,
-        num_self_attention_layers=2, num_self_attention_rotary_layers=1,
-        cross_attention_dropout=0.0, output_norm=True, output_bias=True, abs_pos_emb=True,
+        num_self_attention_layers=2, cross_attention_dropout=0.0, **variant,
     )
     ref = RefCSM(RefCSMConfig(**kwargs)).eval()
     cfg = CausalSequenceModelConfig(**kwargs)
