@@ -26,16 +26,21 @@ from perceiver_io_tpu.training.trainer import TrainState, build_optimizer
 class OptimizerFlags:
     lr: float = 1e-3
     weight_decay: float = 0.0
-    warmup_steps: int = 500
+    warmup_steps: int = 500  # in optimizer-update units (not micro-batches)
     schedule: str = "cosine"  # "cosine" | "constant"
     min_fraction: float = 0.0
     max_grad_norm: Optional[float] = None
+    accumulate_steps: int = 1  # micro-batches per optimizer update
     freeze_encoder: bool = False  # classifier fine-tuning: freeze encoder params
 
 
 def build_tx(flags: OptimizerFlags, max_steps: int):
+    # LR schedules advance once per OPTIMIZER UPDATE: with accumulation, k
+    # micro-batches produce one update, so the horizon is max_steps / k
+    # (warmup_steps is likewise in update units)
+    updates = max(1, max_steps // max(1, flags.accumulate_steps))
     if flags.schedule == "cosine":
-        schedule = cosine_with_warmup(flags.lr, max_steps, flags.warmup_steps, min_fraction=flags.min_fraction)
+        schedule = cosine_with_warmup(flags.lr, updates, flags.warmup_steps, min_fraction=flags.min_fraction)
     elif flags.schedule == "constant":
         schedule = constant_with_warmup(flags.lr, flags.warmup_steps)
     else:
@@ -46,6 +51,7 @@ def build_tx(flags: OptimizerFlags, max_steps: int):
         weight_decay=flags.weight_decay,
         max_grad_norm=flags.max_grad_norm,
         freeze_filter=freeze_filter,
+        accumulate_steps=flags.accumulate_steps,
     )
 
 

@@ -42,12 +42,15 @@ def build_optimizer(
     weight_decay: float = 0.0,
     max_grad_norm: Optional[float] = None,
     freeze_filter: Optional[Callable[[Tuple[str, ...]], bool]] = None,
+    accumulate_steps: int = 1,
     b1: float = 0.9,
     b2: float = 0.999,
 ) -> optax.GradientTransformation:
     """AdamW (+ optional global-norm clipping, matching the FSDP CLI's manual
     clip_grad_norm_, reference scripts/text/clm_fsdp.py:64-67) with optional
-    parameter freezing by path predicate."""
+    parameter freezing by path predicate and gradient accumulation
+    (``accumulate_steps`` micro-batches per update — the reference's Lightning
+    ``accumulate_grad_batches``)."""
     chain = []
     if max_grad_norm is not None:
         chain.append(optax.clip_by_global_norm(max_grad_norm))
@@ -62,6 +65,8 @@ def build_optimizer(
             )
 
         tx = optax.multi_transform({"trainable": tx, "frozen": optax.set_to_zero()}, label_fn)
+    if accumulate_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
     return tx
 
 

@@ -186,3 +186,33 @@ def test_checkpoint_roundtrip(tmp_path):
     restored = restore_checkpoint(path, state)
     assert int(restored.step) == 1
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)), state.params, restored.params)
+
+
+def test_gradient_accumulation():
+    """accumulate_steps=k updates params only every k-th step with the MEAN of
+    the k micro-batch gradients: two identical micro-batches at k=2 must land
+    exactly where one k=1 step on that batch lands (sum semantics would double
+    the effective LR and diverge)."""
+    cfg = CausalSequenceModelConfig(
+        vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+        num_self_attention_layers=2, cross_attention_dropout=0.0,  # no dropout: identical grads
+    )
+    model = CausalSequenceModel(config=cfg, deterministic=True)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (8, 16), 0, 32)
+    batch = {"input_ids": x, "labels": jnp.roll(x, -1, axis=1), "pad_mask": jnp.zeros((8, 16), bool)}
+    params = model.init(rng, x, prefix_len=8)
+    path = lambda p: p["params"]["ar"]["cross_attention"]["cross_attn"]["attention"]["q_proj"]["kernel"]
+
+    tx2 = build_optimizer(1e-2, accumulate_steps=2)
+    s2 = TrainState.create(params, tx2)
+    step2 = jax.jit(make_causal_lm_train_step(model, tx2, max_latents=cfg.max_latents))
+    s2, _ = step2(s2, batch)
+    np.testing.assert_array_equal(np.asarray(path(params)), np.asarray(path(s2.params)))  # no update yet
+    s2, _ = step2(s2, batch)
+
+    tx1 = build_optimizer(1e-2)
+    s1 = TrainState.create(params, tx1)
+    step1 = jax.jit(make_causal_lm_train_step(model, tx1, max_latents=cfg.max_latents))
+    s1, _ = step1(s1, batch)
+    np.testing.assert_allclose(np.asarray(path(s2.params)), np.asarray(path(s1.params)), atol=1e-7)
