@@ -1,0 +1,204 @@
+"""Pallas fused cached-decode attention (single query over a KV ring cache).
+
+The scan-decode hot loop's per-layer attention currently materializes a rotated
+copy of the ENTIRE cached key buffer every token (the reference's torch design
+re-rotates the cache each forward, core modules.py:126-130), then runs masked
+softmax-attention over it — several full HBM round trips per token per layer.
+This kernel streams the caches once: per KV block it applies RoPE to the keys
+in-register, computes masked scores against the single query, and merges into
+flash-style running (max, sum, accumulator) scratch — no rotated-K
+materialization, no (1, cap) score tensor in HBM.
+
+Forward-only (decode is inference); the training paths use the splash kernel.
+Masking: slot j is visible iff j <= q_pos (the ring cache's left-aligned
+validity+causality in one bound, ops/attention.py cached branch) and not a pad
+slot.
+
+SURVEY.md §7 construction item 9 ("fused cached-decode attention").
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 512
+
+
+def decode_kernel_supported(n_q: int, capacity: int, num_qk: int, num_v: int, num_heads: int = 1) -> bool:
+    """Single-token cached decode on one TPU chip with symmetric qk/v widths and
+    a block-tileable cache. Kill-switch: PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL."""
+    if os.environ.get("PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL", "0").lower() not in ("0", "false", ""):
+        return False
+    if jax.default_backend() != "tpu" or jax.device_count() > 1:
+        return False
+    return (
+        n_q == 1
+        and num_qk == num_v
+        and num_heads <= 128  # per-head stats live in one (8, 128) scratch row
+        and capacity % min(_BLOCK, capacity) == 0
+        and capacity >= 128
+        and capacity % 8 == 0  # sublane-aligned KV blocks
+    )
+
+
+def _rotate_half_blockdiag(h: int, d: int, r: int):
+    """Constant (h*d, h*d) block-diagonal matrix: per head, the leading (r, r)
+    corner rotates adjacent pairs [x1, x2] -> [-x2, x1]; the rest is zero.
+    (x @ M) gives rotate_half on each head's rotary dims and 0 elsewhere — a
+    matmul avoids the lane-dim pair-swizzles Mosaic cannot lower."""
+    import numpy as np
+
+    rot = np.zeros((d, d), np.float32)
+    for i in range(0, r, 2):
+        rot[i + 1, i] = -1.0
+        rot[i, i + 1] = 1.0
+    return np.kron(np.eye(h, dtype=np.float32), rot)
+
+
+def _head_expander(h: int, d: int):
+    """Constant (h, h*d) matrix E with (p @ E)[:, head*d + j] == p[:, head] —
+    lane-expands per-head scalars to per-channel without vector broadcasts."""
+    import numpy as np
+
+    return np.kron(np.eye(h, dtype=np.float32), np.ones((1, d), np.float32))
+
+
+def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref):
+    """Grid (B, num_blocks); block i covers cache slots [i*blk, (i+1)*blk).
+
+    qpos_ref (B,)            absolute query positions (scalar-prefetch, SMEM)
+    qbd_ref  (h*d, h)        block-diagonal scaled+rotated query (col head holds q_head)
+    k_ref    (1, blk, h*d)   unrotated keys
+    v_ref    (1, blk, h*d)   values
+    ang_ref  (1, blk, r)     rotary angles per slot (pairwise-repeated)
+    pad_ref  (1, blk, 1)     pad-slot mask (int8, 1 = pad)
+    rot_ref  (h*d, h*d)      block-diag rotate-half matrix
+    exp_ref  (h, h*d)        head->channel expander
+    o_ref    (1, 1, h*d)     output
+    scratch: m, l (8, 128) VMEM (running per-head stats in row 0), acc (8, h*d)
+
+    Everything is a full-width 2D op: the rotate and score contractions are
+    single (blk, h*d) matmuls covering all heads (MXU-shaped, no per-head
+    slicing), and softmax stats live in (1, h) rows that broadcast over
+    sublanes — the orientations Mosaic lowers natively.
+    """
+    import jax.experimental.pallas as pl
+
+    bi = pl.program_id(0)
+    i = pl.program_id(1)
+    nblocks = pl.num_programs(1)
+    blk = k_ref.shape[1]
+    hd, h = qbd_ref.shape
+    r = ang_ref.shape[2]
+    d = hd // h
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ang = ang_ref[0].astype(jnp.float32)  # (blk, r)
+    # tile [angles, identity-fill] across heads -> per-channel (blk, h*d)
+    fill = [jnp.ones((blk, d - r), jnp.float32)] if d > r else []
+    cos = jnp.concatenate(([jnp.cos(ang)] + fill) * h, -1)  # (blk, h*d)
+    sin = jnp.concatenate(([jnp.sin(ang)] + fill) * h, -1)
+
+    k = k_ref[0].astype(jnp.float32)  # (blk, h*d)
+    contract = (((1,), (0,)), ((), ()))
+    rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
+    k = k * cos + rot_half * sin
+
+    sc = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h)
+    q_pos = qpos_ref[bi]
+    slot = i * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
+    visible = (slot <= q_pos) & (pad_ref[0].astype(jnp.int32) == 0)  # (blk, 1)
+    sc = jnp.where(visible, sc, -jnp.inf)
+
+    m_prev = m_ref[:1, :h]
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
+    prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (blk, h)
+
+    prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h*d)
+    pv = jnp.sum(prob_x * v_ref[0].astype(jnp.float32), axis=0, keepdims=True)  # (1, h*d)
+    scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (1, h*d)
+
+    m_ref[:1, :h] = m_new
+    l_ref[:1, :h] = l_ref[:1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
+    acc_ref[:1, :] = acc_ref[:1, :] * scale_x + pv
+
+    @pl.when(i == nblocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:1, :h], 1e-30)
+        l_x = jax.lax.dot_general(1.0 / l, exp_ref[:], contract, preferred_element_type=jnp.float32)
+        o_ref[0] = (acc_ref[:1, :] * l_x).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    rope_k: jax.Array,
+    q_pos: jax.Array,
+    pad_slots: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """q (B, H, 1, D) scaled (+rotated) query; k/v_cache (B, cap, H*D) unrotated;
+    rope_k (B, cap, R) angles; q_pos () or (B,) absolute query position;
+    pad_slots (B, cap). Returns (B, H, 1, D)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, _, d = q.shape
+    cap = k_cache.shape[1]
+    blk = min(_BLOCK, cap)
+    nblocks = cap // blk
+    r = rope_k.shape[-1]
+
+    q_pos_arr = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    # block-diagonal query: column `head` carries q[head] in rows [head*d, (head+1)*d)
+    qbd = (q.reshape(b, h, d).transpose(0, 2, 1)[:, None, :, :] * jnp.eye(h, dtype=q.dtype)[:, None, :]).reshape(b, h * d, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nblocks),
+        in_specs=[
+            pl.BlockSpec((None, h * d, h), lambda bi, i, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
+            pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
+            pl.BlockSpec((1, blk, r), lambda bi, i, *_: (bi, i, 0)),
+            pl.BlockSpec((1, blk, 1), lambda bi, i, *_: (bi, i, 0)),
+            pl.BlockSpec((h * d, h * d), lambda bi, i, *_: (0, 0)),
+            pl.BlockSpec((h, h * d), lambda bi, i, *_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, h * d), lambda bi, i, *_: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, h * d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
+        interpret=interpret,
+    )(
+        q_pos_arr,
+        qbd,
+        k_cache,
+        v_cache,
+        rope_k,
+        pad_slots.astype(jnp.int8)[:, :, None],
+        jnp.asarray(_rotate_half_blockdiag(h, d, r)),
+        jnp.asarray(_head_expander(h, d)),
+    )
+    return out.reshape(b, h, d)[:, :, None, :]
