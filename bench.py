@@ -8,18 +8,26 @@ as a jitted bf16 train step and prints ONE JSON line:
 
 vs_baseline is measured MFU against the BASELINE.json north star of 40% MFU
 (the reference publishes no throughput numbers to compare against directly).
+
+``python bench.py --task optical_flow`` instead benchmarks Perceiver IO
+optical-flow inference at the official deepmind/optical-flow-perceiver dims
+(41M params, 368x496 patches) on Sintel-resolution 436x1024 frame pairs —
+the second BASELINE.json north star. Its vs_baseline is measured frames/s
+against this framework's round-1 reading (4.67 fps/chip), i.e. a
+cross-round regression tracker: the reference publishes no A100 frames/s.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
 
-def main():
+def bench_clm():
     from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
     from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
     from perceiver_io_tpu.training.flops import PerceiverARFlops, detect_peak_flops, mfu
@@ -71,16 +79,78 @@ def main():
     tokens_per_sec = flops_model.tokens_per_step(batch_size) * n_steps / dt
     measured_mfu = mfu(tokens_per_sec, flops_model, batch_size, detect_peak_flops())
 
-    print(
-        json.dumps(
-            {
-                "metric": "perceiver_ar_clm_30m_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "latent_tokens/s",
-                "vs_baseline": round(measured_mfu / 0.40, 4),
-            }
-        )
+    return {
+        "metric": "perceiver_ar_clm_30m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "latent_tokens/s",
+        "vs_baseline": round(measured_mfu / 0.40, 4),
+    }
+
+
+def bench_optical_flow():
+    from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
+    from perceiver_io_tpu.models.vision.optical_flow import (
+        OpticalFlow,
+        OpticalFlowConfig,
+        OpticalFlowDecoderConfig,
+        OpticalFlowEncoderConfig,
     )
+
+    # official deepmind/optical-flow-perceiver dims (reference
+    # vision/optical_flow/huggingface.py; 41M params)
+    enc = OpticalFlowEncoderConfig(
+        image_shape=(368, 496), num_patch_input_channels=27,
+        num_patch_hidden_channels=64, num_frequency_bands=64,
+        num_cross_attention_heads=1, num_self_attention_heads=8,
+        num_self_attention_layers_per_block=24, num_self_attention_blocks=1,
+    )
+    dec = OpticalFlowDecoderConfig(
+        image_shape=(368, 496), num_cross_attention_qk_channels=512,
+        num_cross_attention_v_channels=512, num_cross_attention_heads=1,
+        cross_attention_residual=False,
+    )
+    cfg = OpticalFlowConfig(encoder=enc, decoder=dec, num_latents=2048, num_latent_channels=512)
+    model = OpticalFlow(config=cfg, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    proc = OpticalFlowProcessor(patch_size=(368, 496))
+    n_patches = len(proc.compute_patch_grid_indices((436, 1024)))  # Sintel-resolution frame pair
+    x = jax.random.normal(rng, (n_patches, 2, 27, 368, 496), jnp.bfloat16)
+    params = jax.jit(model.init)(rng, x[:1])
+    apply = jax.jit(lambda p, xx: model.apply(p, xx))
+    o = apply(params, x)
+    float(jnp.abs(o).sum())  # host fetch: see sync note in bench_clm
+
+    best = float("inf")
+    n_pairs = 3
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_pairs):
+            o = apply(params, x)
+        float(jnp.abs(o).sum())
+        best = min(best, time.perf_counter() - t0)
+
+    fps = n_pairs / best
+    return {
+        "metric": "perceiver_io_optical_flow_sintel_frames_per_sec_per_chip",
+        "value": round(fps, 3),
+        "unit": "frame_pairs/s",
+        "vs_baseline": round(fps / 4.67, 4),  # vs this framework's round-1 reading
+    }
+
+
+def main():
+    task = "clm"
+    args = sys.argv[1:]
+    if "--task" in args:
+        idx = args.index("--task")
+        if idx + 1 >= len(args):
+            sys.exit("--task requires a value: clm | optical_flow")
+        task = args[idx + 1]
+    benches = {"clm": bench_clm, "optical_flow": bench_optical_flow}
+    if task not in benches:
+        sys.exit(f"unknown --task {task!r}: expected one of {sorted(benches)}")
+    print(json.dumps(benches[task]()))
 
 
 if __name__ == "__main__":

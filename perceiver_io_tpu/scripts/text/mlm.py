@@ -16,6 +16,7 @@ from perceiver_io_tpu.data.text.common import Task
 from perceiver_io_tpu.data.text.datasets import ImdbDataModule
 from perceiver_io_tpu.models.text.common import TextEncoderConfig
 from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, MaskedLanguageModelConfig, TextDecoderConfig
+from perceiver_io_tpu.models.text.mlm.utils import MaskFiller
 from perceiver_io_tpu.scripts.common import OptimizerFlags, build_tx, run_fit
 from perceiver_io_tpu.training.fit import TrainerConfig
 from perceiver_io_tpu.training.trainer import TrainState, make_mlm_train_step
@@ -54,6 +55,11 @@ def main(argv=None):
     cli.add_group("trainer", TrainerConfig, dict(max_steps=50000, checkpoint_dir="ckpts/mlm"))
     cli.add_flag("num_latents", default="256")
     cli.add_flag("num_latent_channels", default="1280")
+    cli.add_flag(
+        "masked_samples",
+        default="I have watched this <mask> and it was awesome.",
+        help="'|'-separated masked texts filled and logged at each eval",
+    )
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -83,7 +89,18 @@ def main(argv=None):
         logits = eval_model.apply(params, batch["input_ids"], pad_mask=batch.get("pad_mask"))
         return {"loss": cross_entropy(logits, batch["labels"])}
 
-    run_fit(trainer_cfg, state, make_mlm_train_step(model, tx), data, eval_step=eval_step)
+    def on_eval(state, metrics):
+        # qualitative filled-mask samples each eval (reference text/mlm/lightning.py:77-94)
+        masked = [t for t in str(args.masked_samples).split("|") if t]
+        if not masked:  # --masked_samples "" disables the per-eval sampling log
+            return
+        filler = MaskFiller(data.text_preprocessor())
+        _, filled = filler.fill(
+            lambda x, m: eval_model.apply(state.params, x, pad_mask=m), masked, num_predictions=3
+        )
+        print(json.dumps({"filled_samples": filled}))
+
+    run_fit(trainer_cfg, state, make_mlm_train_step(model, tx), data, eval_step=eval_step, on_eval=on_eval)
 
 
 if __name__ == "__main__":
