@@ -116,23 +116,54 @@ class SymbolicAudioPipeline:
         output_midi_path: Optional[str] = None,
         render_wav_path: Optional[str] = None,
         soundfont_path: Optional[str] = None,
+        return_notes: bool = False,
         **generation_kwargs,
     ):
-        import pretty_midi
-
-        from perceiver_io_tpu.data.audio.midi_processor import decode_midi, encode_midi
+        """``midi`` may be a .mid path, a pretty_midi.PrettyMIDI, a sequence of
+        ``midi_processor.Note`` records, or a sequence of event-token ints; only
+        the first two need the optional pretty_midi dependency. With
+        ``return_notes=True`` the return value is always plain ``Note`` records
+        (pretty_midi required only if an output/render path is also given);
+        numpy token arrays (e.g. ``encode_midi_file`` output) are accepted."""
+        from perceiver_io_tpu.data.audio.midi_processor import (
+            Note,
+            decode_midi,
+            decode_notes,
+            encode_midi,
+            encode_notes,
+        )
 
         if isinstance(midi, (str, Path)):
+            import pretty_midi
+
             midi = pretty_midi.PrettyMIDI(str(midi))
-        tokens = encode_midi(midi)
+        if isinstance(midi, np.ndarray):
+            midi = midi.tolist()  # e.g. encode_midi_file output
+        if isinstance(midi, (list, tuple)):
+            if midi and all(isinstance(n, Note) for n in midi):
+                tokens = encode_notes(list(midi))
+            elif all(isinstance(t, (int, np.integer)) for t in midi):
+                tokens = list(midi)
+            else:
+                raise TypeError(
+                    "midi sequence must be all midi_processor.Note records or all int event tokens"
+                )
+        else:
+            tokens = encode_midi(midi)
         if max_prompt_tokens is not None:
             tokens = tokens[-max_prompt_tokens:]
         prompt = jnp.asarray(tokens, jnp.int32)[None]
         out = generate(self.model, self.params, prompt, num_latents=num_latents, rng=rng, **generation_kwargs)
-        generated = decode_midi(np.asarray(out[0]).tolist(), file_path=output_midi_path)
-        if render_wav_path is not None:
-            self.render_wav(generated, render_wav_path, soundfont_path)
-        return generated
+        out_tokens = np.asarray(out[0]).tolist()
+        if output_midi_path is not None or render_wav_path is not None:
+            generated = decode_midi(out_tokens, file_path=output_midi_path)
+            if render_wav_path is not None:
+                self.render_wav(generated, render_wav_path, soundfont_path)
+            if not return_notes:
+                return generated
+        if return_notes:
+            return decode_notes(out_tokens)
+        return decode_midi(out_tokens)
 
     @staticmethod
     def render_wav(midi, wav_path: str, soundfont_path: Optional[str] = None) -> None:

@@ -50,3 +50,33 @@ def test_optical_flow_pipeline_end_to_end():
     assert flow.shape == (1, 12, 12, 2)
     rendered = pipe([(img, img)], render=True)
     assert rendered.shape == (1, 12, 12, 3) and rendered.dtype == np.uint8
+
+
+def test_symbolic_audio_pipeline_notes_roundtrip():
+    """Note records -> event tokens -> generate -> Note records, with no
+    pretty_midi installed (the optional dep is only needed for .mid IO)."""
+    from perceiver_io_tpu.data.audio.midi_processor import NUM_EVENTS, Note, encode_notes
+    from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+    from perceiver_io_tpu.pipelines import SymbolicAudioPipeline
+
+    cfg = SymbolicAudioModelConfig(
+        vocab_size=NUM_EVENTS + 1, max_seq_len=64, max_latents=16, num_channels=16, num_heads=2,
+        num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = SymbolicAudioModel(config=cfg)
+    notes = [Note(pitch=60 + i, velocity=80, start=0.1 * i, end=0.1 * i + 0.2) for i in range(4)]
+    prompt_tokens = encode_notes(notes)
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        jax.random.PRNGKey(0), jnp.zeros((1, len(prompt_tokens)), jnp.int32), prefix_len=2
+    )
+    pipe = SymbolicAudioPipeline(model, params)
+
+    out_notes = pipe(notes, num_latents=4, return_notes=True,
+                     config=GenerationConfig(max_new_tokens=8))
+    assert isinstance(out_notes, list)
+    # the prompt's notes survive the token round trip at the head of the output
+    assert [(n.pitch, n.velocity) for n in out_notes[: len(notes)]] == [(n.pitch, 80) for n in notes]
+
+    # raw token prompts are accepted too
+    out2 = pipe(prompt_tokens, num_latents=4, return_notes=True, config=GenerationConfig(max_new_tokens=8))
+    assert [(n.pitch) for n in out2[: len(notes)]] == [n.pitch for n in notes]
