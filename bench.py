@@ -86,7 +86,9 @@ def bench_clm_455m():
         vocab_size=32000, max_seq_len=1024, max_latents=512, num_channels=1280,
         num_heads=10, num_self_attention_layers=20, cross_attention_dropout=0.0,
         abs_pos_emb=False, output_norm=True, output_bias=False,
-        activation_checkpointing=True,  # rotary layers stay at the reference default (1)
+        # rotary layers stay at the reference default (1); dots-saveable remat
+        # recomputes only elementwise ops in the backward pass (NOTES.md)
+        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
     )
     return _bench_clm_config(config, batch_size=16, n_steps=5,
                              metric="perceiver_ar_clm_455m_train_tokens_per_sec_per_chip")

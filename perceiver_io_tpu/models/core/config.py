@@ -71,6 +71,7 @@ class PerceiverIOConfig(Generic[E, D]):
     num_latents: int
     num_latent_channels: int
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
     activation_offloading: bool = False  # accepted for parity; XLA remat has no CPU-offload knob here
 
 
@@ -93,6 +94,7 @@ class PerceiverARConfig:
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
     activation_offloading: bool = False
     # mesh axis name for sequence-parallel ring attention over the prefix/latent
     # sequences (long-context training beyond one chip's memory); None = off

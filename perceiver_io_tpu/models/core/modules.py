@@ -44,6 +44,34 @@ from perceiver_io_tpu.ops.attention import KVCache, MultiHeadAttention
 LN_EPS = 1e-5  # matches torch.nn.LayerNorm default for checkpoint-conversion parity
 
 
+# the argument-free jax.checkpoint_policies; the factory attributes there
+# (save_only_these_names, offload variants, ...) take arguments and would be
+# silently misapplied if resolved by name
+_REMAT_POLICIES = (
+    "everything_saveable",
+    "nothing_saveable",
+    "dots_saveable",
+    "checkpoint_dots",
+    "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims",
+)
+
+
+def _remat_policy(name: Optional[str], activation_checkpointing: bool = True):
+    """Resolve a jax.checkpoint_policies attribute by name (None = full remat).
+    Policies like ``dots_with_no_batch_dims_saveable`` keep matmul outputs and
+    recompute only the cheap elementwise ops in the backward pass — on the 455M
+    flagship this is the difference between paying a full extra forward and
+    nearly none (see NOTES.md MFU table)."""
+    if name is None:
+        return None
+    if name not in _REMAT_POLICIES:
+        raise ValueError(f"unknown remat_policy {name!r}; expected one of {_REMAT_POLICIES}")
+    if not activation_checkpointing:
+        raise ValueError("remat_policy is set but activation_checkpointing is False; enable it (or clear the policy)")
+    return getattr(jax.checkpoint_policies, name)
+
+
 class MLP(nn.Module):
     num_channels: int
     widening_factor: int
@@ -338,6 +366,7 @@ class SelfAttentionBlock(nn.Module):
     dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name, e.g. "dots_with_no_batch_dims_saveable"
     qkv_bias: bool = True
     out_bias: bool = True
     mlp_bias: bool = True
@@ -378,9 +407,10 @@ class SelfAttentionBlock(nn.Module):
         use_rope = (idx < self.num_rotary_layers) | (self.num_rotary_layers == -1)
         rope_gates = jnp.asarray(use_rope, dtype=jnp.float32)
 
+        policy = _remat_policy(self.remat_policy, self.activation_checkpointing)
         layer_cls = SelfAttentionLayer
         if self.activation_checkpointing:
-            layer_cls = nn.remat(layer_cls)
+            layer_cls = nn.remat(layer_cls, policy=policy)
 
         scanned = nn.scan(
             layer_cls,
@@ -439,6 +469,7 @@ class PerceiverEncoder(nn.Module):
     residual_dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -470,7 +501,7 @@ class PerceiverEncoder(nn.Module):
         def cross_attn(name):
             layer_cls = CrossAttentionLayer
             if self.activation_checkpointing:
-                layer_cls = nn.remat(layer_cls)
+                layer_cls = nn.remat(layer_cls, policy=_remat_policy(self.remat_policy, True))
             return layer_cls(
                 num_heads=self.num_cross_attention_heads,
                 num_q_input_channels=self.num_latent_channels,
@@ -499,6 +530,7 @@ class PerceiverEncoder(nn.Module):
                 dropout=self.dropout,
                 residual_dropout=self.residual_dropout,
                 activation_checkpointing=self.activation_checkpointing,
+                remat_policy=self.remat_policy,
                 init_scale=self.init_scale,
                 deterministic=self.deterministic,
                 dtype=self.dtype,
@@ -555,14 +587,16 @@ class PerceiverDecoder(nn.Module):
     dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
     def setup(self):
+        policy = _remat_policy(self.remat_policy, self.activation_checkpointing)
         layer_cls = CrossAttentionLayer
         if self.activation_checkpointing:
-            layer_cls = nn.remat(layer_cls)
+            layer_cls = nn.remat(layer_cls, policy=policy)
         self.cross_attn = layer_cls(
             num_heads=self.num_cross_attention_heads,
             num_q_input_channels=self.output_query_provider.num_query_channels,

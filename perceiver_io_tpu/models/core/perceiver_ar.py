@@ -97,6 +97,7 @@ class PerceiverAR(nn.Module):
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    remat_policy: Optional[str] = None
     init_scale: float = 0.02
     sequence_parallel_axis: Optional[str] = None  # mesh axis for ring attention (long context)
     deterministic: bool = True
@@ -134,6 +135,7 @@ class PerceiverAR(nn.Module):
             residual_dropout=self.residual_dropout,
             num_rotary_layers=self.num_self_attention_rotary_layers,
             activation_checkpointing=self.activation_checkpointing,
+            remat_policy=self.remat_policy,
             qkv_bias=False,
             out_bias=False,
             mlp_bias=False,
@@ -366,6 +368,7 @@ class CausalSequenceModel(nn.Module):
             sequence_parallel_axis=cfg.sequence_parallel_axis,
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,
+            remat_policy=cfg.remat_policy,
             init_scale=cfg.init_scale,
             deterministic=self.deterministic,
             dtype=self.dtype,

@@ -34,6 +34,7 @@ def make_text_encoder(
     num_latents: int,
     num_latent_channels: int,
     activation_checkpointing: bool = False,
+    remat_policy: Optional[str] = None,
     deterministic: bool = True,
     dtype: Optional[jnp.dtype] = None,
     param_dtype: jnp.dtype = jnp.float32,
@@ -52,6 +53,7 @@ def make_text_encoder(
         num_latents=num_latents,
         num_latent_channels=num_latent_channels,
         activation_checkpointing=activation_checkpointing,
+        remat_policy=remat_policy,
         deterministic=deterministic,
         dtype=dtype,
         param_dtype=param_dtype,

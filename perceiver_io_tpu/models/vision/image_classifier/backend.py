@@ -97,6 +97,7 @@ class ImageClassifier(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            remat_policy=cfg.remat_policy,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -119,6 +120,7 @@ class ImageClassifier(nn.Module):
             ),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            remat_policy=cfg.remat_policy,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -155,6 +155,7 @@ class OpticalFlow(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            remat_policy=cfg.remat_policy,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -172,6 +173,7 @@ class OpticalFlow(nn.Module):
             output_query_provider=OpticalFlowQueryProvider(num_query_channels_=input_adapter.num_input_channels),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            remat_policy=cfg.remat_policy,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -216,3 +216,49 @@ def test_gradient_accumulation():
     step1 = jax.jit(make_causal_lm_train_step(model, tx1, max_latents=cfg.max_latents))
     s1, _ = step1(s1, batch)
     np.testing.assert_allclose(np.asarray(path(s2.params)), np.asarray(path(s1.params)), atol=1e-7)
+
+
+def test_remat_policy_preserves_training_numerics():
+    """activation_checkpointing with a dots-saveable policy must be a pure
+    memory/FLOPs tradeoff: losses and gradients identical to no-remat."""
+    def losses(ckpt, policy):
+        cfg = CausalSequenceModelConfig(
+            vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+            num_self_attention_layers=2, cross_attention_dropout=0.0,
+            activation_checkpointing=ckpt, remat_policy=policy,
+        )
+        model = CausalSequenceModel(config=cfg, deterministic=True)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.randint(rng, (4, 16), 0, 32)
+        batch = {"input_ids": x, "labels": jnp.roll(x, -1, axis=1), "pad_mask": jnp.zeros((4, 16), bool)}
+        params = model.init(rng, x, prefix_len=8)
+        tx = build_optimizer(1e-2)
+        state = TrainState.create(params, tx)
+        step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+        out = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            out.append(float(metrics["loss"]))
+        return out
+
+    base = losses(False, None)
+    np.testing.assert_allclose(losses(True, None), base, rtol=1e-6)
+    np.testing.assert_allclose(losses(True, "dots_with_no_batch_dims_saveable"), base, rtol=1e-6)
+
+
+@pytest.mark.parametrize("policy,checkpointing,match", [
+    ("not_a_policy", True, "unknown remat_policy"),
+    # real jax.checkpoint_policies attribute, but a factory — must be rejected,
+    # not silently misapplied as a policy
+    ("save_only_these_names", True, "unknown remat_policy"),
+    # policy without checkpointing would otherwise be silently ignored
+    ("dots_with_no_batch_dims_saveable", False, "activation_checkpointing is False"),
+])
+def test_remat_policy_validation(policy, checkpointing, match):
+    cfg = CausalSequenceModelConfig(
+        vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+        num_self_attention_layers=1, activation_checkpointing=checkpointing, remat_policy=policy,
+    )
+    model = CausalSequenceModel(config=cfg, deterministic=True)
+    with pytest.raises(ValueError, match=match):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32), prefix_len=4)
