@@ -30,7 +30,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-_BLOCK = 256
+# candidate tile sizes, largest first. 512-wide blocks measured +0.9 MFU
+# points on the 455M flagship (head dim 128) and +16% optical-flow fps (head
+# dim 64 but 2048-long self-attention), yet -8% on the 30M config (head dim
+# 64, 512-long sequences, where one 512 tile covers the whole axis), so they
+# are offered when the head is wide OR the sequences are long; smaller sizes
+# keep shapes like the optical-flow decoder's 182,528 queries (divisible by
+# 256, not 512) on the fused path (NOTES.md)
+_BLOCKS_WIDE = (512, 256, 128)  # head_dim >= 128 or min seq >= 1024
+_BLOCKS_NARROW = (256, 128)
 _DISABLE_ENV = "PERCEIVER_IO_TPU_DISABLE_FLASH"
 _BATCH_AXES = ("data", "fsdp")
 _HEAD_AXIS = "tensor"
@@ -91,28 +99,50 @@ def flash_supported(
         return False  # splash assumes one head_dim for q/k/v
     if num_qk_channels_per_head % 64 != 0:
         return False
-    block = min(_BLOCK, n_q, n_k)
-    return n_q % block == 0 and n_k % block == 0 and n_q >= 128 and n_k >= 128
+    return _pick_block(n_q, n_k, num_qk_channels_per_head) is not None and n_q >= 128 and n_k >= 128
+
+
+def _pick_block(n_q: int, n_k: int, head_dim: int):
+    """Largest candidate tile dividing both sequence lengths (None = no fit).
+
+    Deliberately restricted to power-of-two candidates: the previous
+    ``min(256, n_q, n_k)`` rule would hand shapes like 192 (or any n in
+    [128, 256)) to Mosaic as the tile size itself, which is neither
+    lane-aligned nor ever validated — such shapes now take the XLA path."""
+    wide = head_dim >= 128 or min(n_q, n_k) >= 1024
+    for block in _BLOCKS_WIDE if wide else _BLOCKS_NARROW:
+        if n_q % block == 0 and n_k % block == 0:
+            return block
+    return None
 
 
 @functools.lru_cache(maxsize=64)
-def _kernel(num_heads: int, n_q: int, n_k: int, causal: bool, interpret: bool):
+def _kernel(num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool):
     import jax.experimental.pallas.ops.tpu.splash_attention as sa
 
     # This is usually reached inside a jit trace; mask-info preprocessing must
     # produce concrete arrays (they get cached), not tracers.
     with jax.ensure_compile_time_eval():
-        return _build_kernel(sa, num_heads, n_q, n_k, causal, interpret)
+        return _build_kernel(sa, num_heads, n_q, n_k, block, causal, interpret)
 
 
-def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, causal: bool, interpret: bool):
+def _resolve_block(n_q: int, n_k: int, head_dim: int) -> int:
+    block = _pick_block(n_q, n_k, head_dim)
+    if block is None:
+        raise ValueError(
+            f"no splash tile size fits (n_q={n_q}, n_k={n_k}); "
+            "sequence lengths must be divisible by 128 — gate calls with flash_supported()"
+        )
+    return block
+
+
+def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool):
     if causal:
         # right-aligned causal: query row i sees keys 0..(n_k - n_q + i)
         head_mask = sa.CausalMask((n_q, n_k), offset=n_k - n_q)
     else:
         head_mask = sa.FullMask((n_q, n_k))
     mask = sa.MultiHeadMask([head_mask for _ in range(num_heads)])
-    block = min(_BLOCK, n_q, n_k)
     bs = sa.BlockSizes(
         block_q=block, block_kv=block, block_kv_compute=block,
         block_q_dkv=block, block_kv_dkv=block, block_kv_dkv_compute=block,
@@ -140,7 +170,7 @@ def splash_mha(
     if plan is not None and (plan[0] or plan[1]):
         return _splash_mha_sharded(q, k, v, pad_mask, causal, interpret, plan)
 
-    kernel = _kernel(h, n_q, n_k, causal, interpret)
+    kernel = _kernel(h, n_q, n_k, _resolve_block(n_q, n_k, q.shape[-1]), causal, interpret)
     if pad_mask is None:
         return jax.vmap(kernel)(q, k, v)
 
@@ -168,7 +198,7 @@ def _splash_mha_sharded(q, k, v, pad_mask, causal, interpret, plan):
         raise ValueError(  # flash_supported should have routed this away
             f"splash shard_map needs batch {b} % {b_shards} == 0 and heads {h} % {h_shards} == 0"
         )
-    kernel = _kernel(h // h_shards, n_q, n_k, causal, interpret)
+    kernel = _kernel(h // h_shards, n_q, n_k, _resolve_block(n_q, n_k, q.shape[-1]), causal, interpret)
 
     bspec = baxes if baxes else None
     qkv_spec = P(bspec, head_axis, None, None)
