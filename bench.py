@@ -80,17 +80,9 @@ def _bench_clm_config(config, batch_size, n_steps, metric):
 
 def bench_clm_455m():
     """The reference's published flagship (455M C4, train_fsdp.sh) on one chip."""
-    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.config import flagship_455m_config
 
-    config = CausalSequenceModelConfig(
-        vocab_size=32000, max_seq_len=1024, max_latents=512, num_channels=1280,
-        num_heads=10, num_self_attention_layers=20, cross_attention_dropout=0.0,
-        abs_pos_emb=False, output_norm=True, output_bias=False,
-        # rotary layers stay at the reference default (1); dots-saveable remat
-        # recomputes only elementwise ops in the backward pass (NOTES.md)
-        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
-    )
-    return _bench_clm_config(config, batch_size=16, n_steps=5,
+    return _bench_clm_config(flagship_455m_config(), batch_size=16, n_steps=5,
                              metric="perceiver_ar_clm_455m_train_tokens_per_sec_per_chip")
 
 

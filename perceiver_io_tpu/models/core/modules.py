@@ -372,9 +372,9 @@ class SelfAttentionBlock(nn.Module):
     mlp_bias: bool = True
     init_scale: float = 0.02
     seq_axis: Optional[str] = None
-    scan_unroll: int = 1  # lax.scan unroll factor for the layer loop; measured
-    # NOT beneficial on v5e for the Perceiver AR stack (scan 176.6k vs unroll=8
-    # 159.4k tok/s) — exposed for other shapes/generations
+    scan_unroll: int = 1  # lax.scan unroll factor for the layer loop; config-
+    # dependent: -10% on the 30M config (scan 176.6k vs unroll=8 159.4k tok/s)
+    # but +2.9 MFU points on the 455M flagship at full unroll (NOTES.md)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -419,7 +419,7 @@ class SelfAttentionBlock(nn.Module):
             in_axes=(0, 0, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=self.num_layers,
-            unroll=min(self.scan_unroll, self.num_layers),
+            unroll=max(1, min(self.scan_unroll, self.num_layers)),
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(
             num_heads=self.num_heads,

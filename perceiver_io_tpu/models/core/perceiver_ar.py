@@ -98,6 +98,7 @@ class PerceiverAR(nn.Module):
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None
+    scan_unroll: int = 1
     init_scale: float = 0.02
     sequence_parallel_axis: Optional[str] = None  # mesh axis for ring attention (long context)
     deterministic: bool = True
@@ -136,6 +137,7 @@ class PerceiverAR(nn.Module):
             num_rotary_layers=self.num_self_attention_rotary_layers,
             activation_checkpointing=self.activation_checkpointing,
             remat_policy=self.remat_policy,
+            scan_unroll=self.scan_unroll,
             qkv_bias=False,
             out_bias=False,
             mlp_bias=False,
@@ -369,6 +371,7 @@ class CausalSequenceModel(nn.Module):
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            scan_unroll=cfg.scan_unroll,
             init_scale=cfg.init_scale,
             deterministic=self.deterministic,
             dtype=self.dtype,

@@ -246,6 +246,30 @@ def test_remat_policy_preserves_training_numerics():
     np.testing.assert_allclose(losses(True, "dots_with_no_batch_dims_saveable"), base, rtol=1e-6)
 
 
+def test_scan_unroll_preserves_training_numerics():
+    """Unrolling the layer scan is a pure compile-time tradeoff."""
+    def losses(unroll):
+        cfg = CausalSequenceModelConfig(
+            vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+            num_self_attention_layers=2, cross_attention_dropout=0.0, scan_unroll=unroll,
+        )
+        model = CausalSequenceModel(config=cfg, deterministic=True)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.randint(rng, (4, 16), 0, 32)
+        batch = {"input_ids": x, "labels": jnp.roll(x, -1, axis=1), "pad_mask": jnp.zeros((4, 16), bool)}
+        params = model.init(rng, x, prefix_len=8)
+        tx = build_optimizer(1e-2)
+        state = TrainState.create(params, tx)
+        step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents))
+        out = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            out.append(float(metrics["loss"]))
+        return out
+
+    np.testing.assert_allclose(losses(2), losses(1), rtol=1e-6)
+
+
 @pytest.mark.parametrize("policy,checkpointing,match", [
     ("not_a_policy", True, "unknown remat_policy"),
     # real jax.checkpoint_policies attribute, but a factory — must be rejected,
