@@ -6,11 +6,21 @@ collators are cheap numpy ops, batches are handed to ``jax.device_put`` (or
 ``make_array_from_process_local_data`` for multi-host), and heavy preprocessing
 happens once, offline (see TextDataModule.prepare_data). This loader keeps the
 epoch/shuffle/collate contract with an explicit RNG and no worker machinery.
+
+Exact mid-epoch resume (beyond the reference, whose Lightning restarts repeat
+or skip data after preemption): ``state_dict()`` captures the RNG state as of
+the current epoch's start plus the number of batches already consumed;
+``load_state_dict()`` replays the same permutation and skips the consumed
+prefix, so training continues on precisely the next unseen batch. The
+guarantee covers batch ORDER and POSITION (no example repeated or skipped);
+stochastic collator augmentation (dynamic masking, random truncation/shift)
+draws fresh randomness after a restore — give the loader a dedicated RNG, as
+the data modules do.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -31,16 +41,43 @@ class DataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.rng = rng if rng is not None else np.random.default_rng()
+        self._epoch_start_rng_state = self.rng.bit_generator.state
+        self._consumed = 0  # batches yielded in the current epoch
+        self._skip = 0  # batches to fast-forward on the next epoch (restore)
 
     def __len__(self) -> int:
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
 
+    def state_dict(self) -> Dict:
+        """Snapshot for exact resume: the RNG state that produced (or will
+        produce) the current epoch's permutation, and how many of its batches
+        have been consumed."""
+        return {
+            "rng_state": self._epoch_start_rng_state,
+            "batches_consumed": self._consumed,
+        }
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.rng.bit_generator.state = state["rng_state"]
+        self._epoch_start_rng_state = state["rng_state"]
+        self._skip = int(state["batches_consumed"])
+        self._consumed = self._skip
+
     def __iter__(self):
         n = len(self.dataset)
+        if self._skip == 0:
+            # fresh epoch: snapshot the RNG before drawing the permutation so a
+            # restore can replay the identical order
+            self._epoch_start_rng_state = self.rng.bit_generator.state
+            self._consumed = 0
         order = self.rng.permutation(n) if self.shuffle else np.arange(n)
         stop = n - (n % self.batch_size) if self.drop_last else n
-        for start in range(0, stop, self.batch_size):
+        skip, self._skip = self._skip, 0
+        for bi, start in enumerate(range(0, stop, self.batch_size)):
+            if bi < skip:
+                continue
             idx = order[start : start + self.batch_size]
             examples = [self.dataset[int(i)] for i in idx]
+            self._consumed = bi + 1
             yield self.collate_fn(examples) if self.collate_fn else examples

@@ -463,7 +463,12 @@ class TextDataModule:
             labels, input_ids, pad_mask = collator(examples)
             return {"labels": labels, "input_ids": input_ids, "pad_mask": pad_mask}
 
-        return DataLoader(dataset, batch_size, collate_fn=collate, shuffle=shuffle, drop_last=drop_last, rng=self._rng)
+        # the loader gets its OWN generator (spawned off the module seed) so its
+        # state_dict/exact-resume covers the batch order independently of the
+        # collators' per-batch draws (dynamic masking/truncation/shift), which
+        # remain fresh randomness after a restore
+        loader_rng = np.random.default_rng(self._rng.integers(0, 2**63))
+        return DataLoader(dataset, batch_size, collate_fn=collate, shuffle=shuffle, drop_last=drop_last, rng=loader_rng)
 
     def train_dataloader(self) -> DataLoader:
         return self._dataloader(

@@ -107,7 +107,8 @@ class MNISTDataModule:
         }
 
     def train_dataloader(self) -> DataLoader:
-        return DataLoader(self.ds_train, self.batch_size, collate_fn=self._collate, shuffle=self.shuffle, rng=self._rng)
+        loader_rng = np.random.default_rng(self._rng.integers(0, 2**63))
+        return DataLoader(self.ds_train, self.batch_size, collate_fn=self._collate, shuffle=self.shuffle, rng=loader_rng)
 
     def val_dataloader(self) -> DataLoader:
         return DataLoader(self.ds_valid, self.batch_size, collate_fn=self._collate, shuffle=False, drop_last=False)

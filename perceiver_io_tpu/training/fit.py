@@ -75,9 +75,17 @@ class Trainer:
         best = None
         step_count = int(state.step)
         window_t0, window_steps = time.perf_counter(), 0
+        # A stateful (resumable) loader is obtained ONCE and re-iterated per
+        # epoch, so restored mid-epoch positions survive and its state can be
+        # checkpointed; stateless sources keep the build-per-epoch contract.
+        first_source = train_loader_fn()
+        stateful = hasattr(first_source, "state_dict")
+        self._train_source = first_source if stateful else None
 
         while step_count < cfg.max_steps:
-            for batch in train_loader_fn():
+            epoch_source = first_source if stateful else train_loader_fn()
+            self._train_source = epoch_source if stateful else None
+            for batch in epoch_source:
                 state, metrics = step_fn(state, put(batch))
                 step_count += 1
                 window_steps += 1
@@ -111,7 +119,29 @@ class Trainer:
 
         if cfg.checkpoint_dir:
             save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
+            self._save_iterator_state("last_iterator.json")
         return state
+
+    def _save_iterator_state(self, filename: str) -> None:
+        """Persist the train loader's exact position (epoch RNG + consumed
+        batches) next to the checkpoint, when the loader supports it — enables
+        resume on precisely the next unseen batch (data/loader.py), a recovery
+        guarantee the reference's Lightning restarts do not make."""
+        src = getattr(self, "_train_source", None)
+        if not self.config.checkpoint_dir or src is None or not hasattr(src, "state_dict"):
+            return
+        path = os.path.join(self.config.checkpoint_dir, filename)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(src.state_dict(), f)
+        os.replace(tmp, path)  # atomic: a preemption mid-write cannot corrupt the snapshot
+
+    @staticmethod
+    def restore_iterator(path: str, loader) -> None:
+        """Load an iterator-state JSON (written next to checkpoints) into a
+        loader with ``load_state_dict``."""
+        with open(path) as f:
+            loader.load_state_dict(json.load(f))
 
     def evaluate(self, state: TrainState, eval_fn, loader, put) -> Dict[str, float]:
         totals: Dict[str, float] = {}
@@ -131,6 +161,8 @@ class Trainer:
         better = best is None or (value < best if cfg.monitor_mode == "min" else value > best)
         if better:
             save_checkpoint(os.path.join(cfg.checkpoint_dir, "best"), state)
+            # keep the iterator snapshot in lockstep with the weights it pairs with
+            self._save_iterator_state("best_iterator.json")
             self.log(json.dumps({"checkpoint": "best", cfg.monitor: round(value, 5)}))
             return value
         return best
