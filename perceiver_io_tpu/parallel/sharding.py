@@ -13,11 +13,13 @@ Rules are path-based over the flax param tree (works for both plain and
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from perceiver_io_tpu.parallel.mesh import DATA_AXES
 
 # (parent module, param name) -> which logical dim is sharded over `tensor`
 # dims are counted from the END so scanned params (leading layer axis) work too:
@@ -32,7 +34,20 @@ _TENSOR_RULES = {
 }
 
 
-def _spec_for(path: Tuple[str, ...], value, mesh: Mesh, min_fsdp_size: int) -> PartitionSpec:
+def _is_embedding_family(path: Tuple[str, ...]) -> bool:
+    """Embedding tables and the tied output head: their grads are built by
+    scatter-adds / vocab-dim contractions from batch-sharded cotangents."""
+    return any("embedding" in p or p == "output_adapter" for p in path)
+
+
+def _spec_for(
+    path: Tuple[str, ...],
+    value,
+    mesh,
+    min_fsdp_size: int,
+    exclude_dims: Tuple[int, ...] = (),
+) -> PartitionSpec:
+    """Dims in ``exclude_dims`` (e.g. the scan-layer axis) never get sharded."""
     ndim = np.ndim(value)
     shape = np.shape(value)
     axes: list = [None] * ndim
@@ -52,23 +67,47 @@ def _spec_for(path: Tuple[str, ...], value, mesh: Mesh, min_fsdp_size: int) -> P
         candidates = [
             (shape[d], d)
             for d in range(ndim)
-            if d != tensor_dim and shape[d] % mesh.shape["fsdp"] == 0 and shape[d] > 1
+            if d != tensor_dim
+            and d not in exclude_dims
+            and shape[d] % mesh.shape["fsdp"] == 0
+            and shape[d] > 1
         ]
         if candidates:
             _, d = max(candidates)
             axes[d] = "fsdp"
+            if _is_embedding_family(path):
+                # Embedding-family grads reshard from batch-sharded cotangents
+                # (PartitionSpec(("data","fsdp")) on dim 0) to the param sharding.
+                # GSPMD can move a sharded dim cheaply (all-to-all) only between
+                # shardings with compatible device orders; bare "fsdp" (a
+                # non-major mesh axis) is not order-compatible with the combined
+                # batch axes and triggers "involuntary full rematerialization"
+                # (replicate-then-reshard) of the whole grad. Sharding these
+                # params over the combined data axes keeps the device order
+                # row-major-compatible — and is strictly deeper ZeRO-3.
+                combined = tuple(a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
+                if len(combined) > 1 and shape[d] % int(np.prod([mesh.shape[a] for a in combined])) == 0:
+                    axes[d] = combined
 
     return PartitionSpec(*axes)
+
+
+# name of the nn.scan module holding stacked per-layer params (modules.py
+# SelfAttentionBlock); its leading axis is the scan axis and is never sharded
+SCAN_MODULE_NAME = "layers"
 
 
 def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12):
     """NamedSharding pytree for a param tree: tensor rules first, then FSDP on the
     largest divisible dim of every sufficiently large parameter; small params
-    replicate."""
+    replicate. Scan-stacked params (under ``layers``) never shard their leading
+    layer axis — slicing a sharded scan axis would turn every loop iteration into
+    a cross-device gather."""
 
     def f(path, value):
         keys = tuple(getattr(k, "key", str(k)) for k in path)
-        return NamedSharding(mesh, _spec_for(keys, value, mesh, min_fsdp_size))
+        exclude = (0,) if SCAN_MODULE_NAME in keys else ()
+        return NamedSharding(mesh, _spec_for(keys, value, mesh, min_fsdp_size, exclude_dims=exclude))
 
     return jax.tree_util.tree_map_with_path(f, params)
 

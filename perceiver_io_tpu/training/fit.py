@@ -23,7 +23,12 @@ from typing import Callable, Dict, Iterable, Optional
 import jax
 import numpy as np
 
-from perceiver_io_tpu.parallel.api import make_sharded_eval_step, make_sharded_train_step, shard_train_state
+from perceiver_io_tpu.parallel.api import (
+    create_sharded_state,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    shard_train_state,
+)
 from perceiver_io_tpu.parallel.mesh import batch_sharding, make_mesh
 from perceiver_io_tpu.training.checkpoint import restore_checkpoint, save_checkpoint
 from perceiver_io_tpu.training.trainer import TrainState
@@ -52,22 +57,33 @@ class Trainer:
 
     def fit(
         self,
-        state: TrainState,
+        state,  # TrainState, or a zero-arg TrainState factory (preferred at scale)
         train_step: Callable,
         train_loader_fn: Callable[[], Iterable],
         eval_step: Optional[Callable] = None,
         eval_loader_fn: Optional[Callable[[], Iterable]] = None,
         on_eval: Optional[Callable[[TrainState, Dict], None]] = None,
     ) -> TrainState:
+        """``state`` may be a materialized TrainState or a zero-arg factory
+        (``lambda: TrainState.create(model.init(...), tx)``). With ``mesh_axes``
+        set, the factory initializes params + optimizer moments directly sharded
+        on the mesh (jitted init with out_shardings) — a materialized state is
+        instead host-resident in full and resharded via device_put, which peaks
+        at model-size host/device memory and is fine only below that scale."""
         cfg = self.config
 
         if cfg.mesh_axes:
             mesh = make_mesh(cfg.mesh_axes)
-            state, state_sh = shard_train_state(state, mesh, mode=cfg.parallel_mode)
+            if callable(state):
+                state, state_sh = create_sharded_state(state, mesh, mode=cfg.parallel_mode)
+            else:
+                state, state_sh = shard_train_state(state, mesh, mode=cfg.parallel_mode)
             step_fn = make_sharded_train_step(train_step, mesh, state_sh)
             eval_fn = make_sharded_eval_step(eval_step, mesh, state_sh.params) if eval_step else None
             put = lambda b: jax.device_put(b, batch_sharding(mesh))
         else:
+            if callable(state):
+                state = jax.jit(state)()
             step_fn = jax.jit(train_step, donate_argnums=(0,))
             eval_fn = jax.jit(eval_step) if eval_step else None
             put = lambda b: b

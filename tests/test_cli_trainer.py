@@ -50,8 +50,9 @@ def test_cli_optional_and_bool_and_tuple_parsing():
     assert cfg.num_cross_attention_qk_channels is None
 
 
-def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
-    """End-to-end fit: loss logging, periodic eval, best-checkpoint selection."""
+def tiny_fit_setup():
+    """Shared fixture for Trainer.fit tests: a 2-class linear model on separable
+    synthetic data, with hand-rolled train/eval steps and a fixed-batch loader."""
     import flax.linen as nn
     import optax
 
@@ -64,9 +65,7 @@ def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
     rng = jax.random.PRNGKey(0)
     Y = (jax.random.uniform(rng, (64,)) > 0.5).astype(jnp.int32)
     X = jax.random.normal(rng, (64, 8)) + Y[:, None]
-    params = model.init(rng, X[:2])
     tx = build_optimizer(1e-2)
-    state = TrainState.create(params, tx)
 
     def train_step(state, batch):
         def loss_fn(p):
@@ -84,6 +83,14 @@ def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
         return {"loss": loss, "acc": (logits.argmax(-1) == batch["y"]).mean()}
 
     loader = lambda: iter([{"x": X, "y": Y}] * 10)
+    init_fn = lambda: model.init(rng, X[:2])
+    return init_fn, tx, train_step, eval_step, loader
+
+
+def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
+    """End-to-end fit: loss logging, periodic eval, best-checkpoint selection."""
+    init_fn, tx, train_step, eval_step, loader = tiny_fit_setup()
+    state = TrainState.create(init_fn(), tx)
     logs = []
     trainer = Trainer(
         TrainerConfig(max_steps=50, eval_every=10, log_every=10, checkpoint_dir=str(tmp_path), tokens_per_batch=64),
@@ -99,6 +106,21 @@ def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
     assert any("tokens_per_sec" in l for l in logs)
     restored = Trainer.restore(str(tmp_path / "last"), final)
     assert int(restored.step) == 50
+
+
+def test_trainer_fit_accepts_state_factory_on_mesh():
+    """fit() with a zero-arg TrainState factory + mesh_axes initializes directly
+    sharded (jitted init with out_shardings, no host-resident full copy)."""
+    init_fn, tx, train_step, _, loader = tiny_fit_setup()
+    logs = []
+    trainer = Trainer(
+        TrainerConfig(max_steps=10, log_every=5, mesh_axes={"data": 8}, parallel_mode="dp"),
+        log_fn=lambda line: logs.append(json.loads(line)),
+    )
+    final = trainer.fit(lambda: TrainState.create(init_fn(), tx), train_step, loader)
+    assert int(final.step) == 10
+    losses = [l["loss"] for l in logs if "loss" in l]
+    assert losses[-1] < losses[0]
 
 
 def test_task_clis_parse_help():

@@ -17,8 +17,13 @@ from perceiver_io_tpu.models.vision.image_classifier.backend import (
     ImageClassifierConfig,
     ImageEncoderConfig,
 )
-from perceiver_io_tpu.parallel.api import make_sharded_train_step, shard_train_state
+from perceiver_io_tpu.parallel.api import (
+    create_sharded_train_state,
+    make_sharded_train_step,
+    shard_train_state,
+)
 from perceiver_io_tpu.parallel.mesh import batch_sharding, make_mesh
+from perceiver_io_tpu.parallel.sharding import infer_param_shardings
 from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
 from perceiver_io_tpu.training.trainer import (
     TrainState,
@@ -171,6 +176,59 @@ def test_sharded_training_matches_single_device(axes, mode):
         # verify parameters are actually distributed, not replicated
         kernel = sharded_state.params["params"]["ar"]["self_attention"]["layers"]["mlp"]["dense_1"]["kernel"]
         assert not kernel.sharding.is_fully_replicated
+
+
+def test_param_sharding_rules():
+    """Embedding-family params shard over the combined data axes (device-order
+    compatibility with batch-sharded grad cotangents — avoids GSPMD involuntary
+    full rematerialization); scan-stacked params never shard the layer axis."""
+    mesh = make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    params = {
+        "params": {
+            "input_adapter": {"txt_embedding": {"embedding": jnp.zeros((64, 32))}},
+            # layer axis (48) is the largest divisible dim but must not be sharded
+            "self_attention": {"layers": {"norm": {"scale": jnp.zeros((48, 32))}}},
+            "cross_attn": {"attention": {"q_proj": {"kernel": jnp.zeros((32, 32))}}},
+        }
+    }
+    sh = infer_param_shardings(params, mesh, min_fsdp_size=1)
+    p = sh["params"]
+    assert p["input_adapter"]["txt_embedding"]["embedding"].spec == jax.sharding.PartitionSpec(("data", "fsdp"), None)
+    assert p["self_attention"]["layers"]["norm"]["scale"].spec == jax.sharding.PartitionSpec(None, "fsdp")
+    assert p["cross_attn"]["attention"]["q_proj"]["kernel"].spec == jax.sharding.PartitionSpec("fsdp", "tensor")
+
+
+def test_create_sharded_train_state_matches_host_init():
+    """Jitted init with out_shardings must produce the same params and the same
+    loss trajectory as host init + device_put (shard_train_state)."""
+    model, cfg, params, batch = lm_setup()
+    tx = build_optimizer(1e-3)
+    mesh = make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+
+    rng = jax.random.PRNGKey(0)
+    state, state_sh = create_sharded_train_state(
+        lambda: model.init({"params": rng, "dropout": rng}, batch["input_ids"], prefix_len=8),
+        tx,
+        mesh,
+        min_fsdp_size=1,
+    )
+    ref_state, _ = shard_train_state(
+        TrainState.create(model.init({"params": rng, "dropout": rng}, batch["input_ids"], prefix_len=8), tx),
+        mesh,
+        min_fsdp_size=1,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+        state.params,
+        ref_state.params,
+    )
+    kernel = state.params["params"]["ar"]["self_attention"]["layers"]["mlp"]["dense_1"]["kernel"]
+    assert not kernel.sharding.is_fully_replicated
+
+    step = make_sharded_train_step(make_causal_lm_train_step(model, tx, max_latents=cfg.max_latents), mesh, state_sh)
+    gbatch = jax.device_put(batch, batch_sharding(mesh))
+    state, metrics = step(state, gbatch)
+    assert np.isfinite(float(metrics["loss"]))
 
 
 def test_checkpoint_roundtrip(tmp_path):
