@@ -188,7 +188,13 @@ def image_classifier_from_hf(hf_model) -> Tuple[object, Dict]:
         decoder=ClassificationDecoderConfig(
             num_classes=c.num_labels,
             num_output_query_channels=c.d_latents,
-            num_cross_attention_heads=c.num_cross_attention_heads,
+            # HF's PerceiverClassificationDecoder hardcodes num_heads=1 (its
+            # PerceiverBasicDecoder default), independent of the config's
+            # num_cross_attention_heads; official checkpoints use 1 anyway.
+            # (The reference converter copies config.num_cross_attention_heads,
+            # vision/image_classifier/huggingface.py:199 — a latent mismatch it
+            # never hits.)
+            num_cross_attention_heads=1,
             cross_attention_residual=True,
             dropout=c.attention_probs_dropout_prob,
             init_scale=c.initializer_range,
@@ -244,9 +250,11 @@ def optical_flow_from_hf(hf_model) -> Tuple[object, Dict]:
         decoder=OpticalFlowDecoderConfig(
             image_shape=image_shape,
             # HF's flow decoder attends with qk = v = d_latents (512 officially)
+            # and hardcodes num_heads=1 (PerceiverBasicDecoder default) — see
+            # the classification-decoder note above
             num_cross_attention_qk_channels=c.d_latents,
             num_cross_attention_v_channels=c.d_latents,
-            num_cross_attention_heads=c.num_cross_attention_heads,
+            num_cross_attention_heads=1,
             cross_attention_widening_factor=c.cross_attention_widening_factor,
             cross_attention_residual=False,
             dropout=c.attention_probs_dropout_prob,

@@ -1,16 +1,31 @@
-"""Export flax-trained params INTO official HF ``transformers`` Perceiver models
-— the inverse of ``convert_hf`` and the counterpart of the reference's
+"""Export flax-trained params into the torch/HF ecosystem — the inverse of
+``convert_hf``/``convert_torch`` and the counterpart of the reference's per-task
 ``convert_checkpoint`` utilities (Lightning ckpt -> HF save_pretrained dir,
-e.g. reference text/clm/huggingface.py:57-65): train on TPU here, publish into
-the HF ecosystem.
+reference text/clm/huggingface.py:57-65, text/classifier/huggingface.py:66-84,
+vision/image_classifier/huggingface.py:120-137, vision/optical_flow/huggingface.py:108-124,
+audio/symbolic/huggingface.py:176-200): train on TPU here, publish elsewhere.
 
-Currently supports the MaskedLanguageModel -> PerceiverForMaskedLM direction
-(the reference's primary published-checkpoint family); the mapping tables are
-shared with convert_hf, transposed.
+Two export targets, per family:
+
+  - **Official ``transformers`` classes** where they exist (the formats of the
+    DeepMind hub checkpoints): MaskedLanguageModel -> ``PerceiverForMaskedLM``,
+    ImageClassifier -> ``PerceiverForImageClassificationFourier``,
+    OpticalFlow -> ``PerceiverForOpticalFlow``.
+  - **Reference-layout torch state dicts** for the Perceiver AR families and the
+    text classifier (``transformers`` has no Perceiver AR architecture — the
+    reference exports these as its own custom classes, whose weights are exactly
+    the backend state dict): CausalLanguageModel / SymbolicAudioModel /
+    TextClassifier -> a state dict loadable by the reference's backend modules
+    with ``load_state_dict`` (missing keys are only recomputed buffers).
+
+All mapping tables are shared with convert_hf / convert_torch, transposed.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import os
 from typing import Dict, Mapping
 
 
@@ -93,12 +108,7 @@ def masked_language_model_to_hf(config, params) -> "object":
             "(requires cross_attention_residual=False, heads=8, qk_channels=256, "
             "v_channels=d_model)"
         )
-    # HF encoders repeat ONE weight-shared block; unshared repeats and repeated
-    # cross-attention have no HF equivalent
-    if enc.num_cross_attention_layers != 1:
-        raise ValueError("repeated cross-attention (num_cross_attention_layers > 1) cannot map onto HF Perceiver")
-    if enc.num_self_attention_blocks > 1 and not enc.first_self_attention_block_shared:
-        raise ValueError("unshared self-attention blocks cannot map onto HF Perceiver (blocks are weight-shared)")
+    _check_hf_mappable_encoder(enc)
     hf_config = transformers.PerceiverConfig(
         vocab_size=enc.vocab_size,
         max_position_embeddings=enc.max_seq_len,
@@ -147,3 +157,379 @@ def export_masked_language_model(config, params, save_dir: str) -> None:
     """One-call export: flax MLM -> HF save_pretrained directory."""
     model = masked_language_model_to_hf(config, params)
     model.save_pretrained(save_dir)
+
+
+# ------------------------------------------------------- official HF: vision
+
+
+def _check_single_qkv_width(enc, qk, v, d_latents):
+    """HF Perceiver uses ONE qk/v width for cross- and self-attention; compare
+    RESOLVED widths (flax: None -> block channels; HF: None -> d_latents)."""
+    self_qk = enc.num_self_attention_qk_channels or d_latents
+    self_v = enc.num_self_attention_v_channels or self_qk
+    if self_qk != (qk or d_latents) or self_v != (v or qk or d_latents):
+        raise ValueError("HF Perceiver uses one qk/v width for cross- and self-attention")
+
+
+def _check_hf_mappable_encoder(enc):
+    """HF encoders repeat ONE weight-shared block; unshared repeats and repeated
+    cross-attention have no HF equivalent."""
+    if enc.num_cross_attention_layers != 1:
+        raise ValueError("repeated cross-attention (num_cross_attention_layers > 1) cannot map onto HF Perceiver")
+    if enc.num_self_attention_blocks > 1 and not enc.first_self_attention_block_shared:
+        raise ValueError("unshared self-attention blocks cannot map onto HF Perceiver (blocks are weight-shared)")
+
+
+def image_classifier_to_hf(config, params) -> "object":
+    """Build a transformers.PerceiverForImageClassificationFourier carrying these
+    flax params (inverse of convert_hf.image_classifier_from_hf). The HF class
+    hardcodes its fourier preprocessor (num_bands=64, max_resolution=(224,224))
+    and uses a single qk/v width for both cross- and self-attention."""
+    import transformers
+
+    enc = config.encoder
+    dec = config.decoder
+    if tuple(enc.image_shape) != (224, 224, 3) or enc.num_frequency_bands != 64:
+        raise ValueError(
+            "PerceiverForImageClassificationFourier hardcodes image_shape=(224,224,3), "
+            "num_frequency_bands=64"
+        )
+    qk = enc.num_cross_attention_qk_channels
+    v = enc.num_cross_attention_v_channels
+    _check_single_qkv_width(enc, qk, v, config.num_latent_channels)
+    if (
+        dec.num_output_queries != 1
+        or dec.num_output_query_channels != config.num_latent_channels
+        or not dec.cross_attention_residual
+        or dec.num_cross_attention_heads != 1
+    ):
+        raise ValueError(
+            "HF's classification decoder hardcodes one output query of d_latents "
+            "channels with a residual and num_heads=1"
+        )
+    _check_hf_mappable_encoder(enc)
+    # d_model = fourier channels + raw pixel channels: 2 dims * (2*64 bands + 1) + 3
+    hf_config = transformers.PerceiverConfig(
+        num_latents=config.num_latents,
+        d_latents=config.num_latent_channels,
+        d_model=261,
+        num_blocks=enc.num_self_attention_blocks,
+        num_self_attends_per_block=enc.num_self_attention_layers_per_block,
+        num_self_attention_heads=enc.num_self_attention_heads,
+        num_cross_attention_heads=enc.num_cross_attention_heads,
+        qk_channels=qk,
+        v_channels=v,
+        num_labels=dec.num_classes,
+        image_size=224,
+        cross_attention_widening_factor=enc.cross_attention_widening_factor,
+        self_attention_widening_factor=enc.self_attention_widening_factor,
+        attention_probs_dropout_prob=enc.dropout,
+        initializer_range=enc.init_scale,
+    )
+    model = transformers.PerceiverForImageClassificationFourier(hf_config)
+
+    p = params["params"]
+    sd = dict(model.state_dict())
+    encoder = p["encoder"]
+    sd["perceiver.embeddings.latents"] = _to_torch(encoder["latent_provider"]["query"])
+    _set_cross_attention_layer(sd, "perceiver.encoder.cross_attention", encoder["cross_attn_1"])
+    _set_self_attention_block(
+        sd, "perceiver.encoder.self_attends", encoder["self_attn_1"]["layers"], enc.num_self_attention_layers_per_block
+    )
+    decoder = p["decoder"]
+    sd["perceiver.decoder.decoder.output_position_encodings.position_embeddings"] = _to_torch(
+        decoder["output_query_provider"]["query"]
+    )
+    _set_cross_attention_layer(sd, "perceiver.decoder.decoder.decoding_cross_attention", decoder["cross_attn"])
+    _set_dense(sd, "perceiver.decoder.decoder.final_layer", decoder["output_adapter"]["linear"])
+
+    model.load_state_dict(sd)
+    return model
+
+
+def optical_flow_to_hf(config, params) -> "object":
+    """Build a transformers.PerceiverForOpticalFlow carrying these flax params
+    (inverse of convert_hf.optical_flow_from_hf)."""
+    import transformers
+
+    enc = config.encoder
+    dec = config.decoder
+    if enc.num_frequency_bands != 64 or enc.num_patch_input_channels != 27 or enc.num_patch_hidden_channels != 64:
+        raise ValueError(
+            "PerceiverForOpticalFlow hardcodes 27 patch channels -> Linear(54->64) "
+            "and num_frequency_bands=64"
+        )
+    qk = enc.num_cross_attention_qk_channels
+    v = enc.num_cross_attention_v_channels
+    _check_single_qkv_width(enc, qk, v, config.num_latent_channels)
+    if (
+        dec.num_cross_attention_qk_channels != config.num_latent_channels
+        or dec.num_cross_attention_v_channels != config.num_latent_channels
+        or dec.cross_attention_residual
+        or dec.num_cross_attention_heads != 1
+    ):
+        raise ValueError("HF's flow decoder hardcodes qk=v=d_latents, no residual, num_heads=1")
+    if dec.rescale_factor != 100.0 or tuple(dec.image_shape) != tuple(enc.image_shape):
+        raise ValueError(
+            "PerceiverForOpticalFlow hardcodes rescale_factor=100.0 and decodes at "
+            "train_size (decoder image_shape must equal the encoder's)"
+        )
+    _check_hf_mappable_encoder(enc)
+    # d_model = patch hidden + fourier channels: 64 + 2 dims * (2*64 bands + 1)
+    hf_config = transformers.PerceiverConfig(
+        num_latents=config.num_latents,
+        d_latents=config.num_latent_channels,
+        d_model=322,
+        num_blocks=enc.num_self_attention_blocks,
+        num_self_attends_per_block=enc.num_self_attention_layers_per_block,
+        num_self_attention_heads=enc.num_self_attention_heads,
+        num_cross_attention_heads=enc.num_cross_attention_heads,
+        qk_channels=qk,
+        v_channels=v,
+        train_size=list(enc.image_shape),
+        cross_attention_widening_factor=enc.cross_attention_widening_factor,
+        self_attention_widening_factor=enc.self_attention_widening_factor,
+        attention_probs_dropout_prob=enc.dropout,
+        initializer_range=enc.init_scale,
+    )
+    model = transformers.PerceiverForOpticalFlow(hf_config)
+
+    p = params["params"]
+    sd = dict(model.state_dict())
+    encoder = p["encoder"]
+    sd["perceiver.embeddings.latents"] = _to_torch(encoder["latent_provider"]["query"])
+    _set_dense(sd, "perceiver.input_preprocessor.conv_after_patches", encoder["input_adapter"]["linear"])
+    _set_cross_attention_layer(sd, "perceiver.encoder.cross_attention", encoder["cross_attn_1"])
+    _set_self_attention_block(
+        sd, "perceiver.encoder.self_attends", encoder["self_attn_1"]["layers"], enc.num_self_attention_layers_per_block
+    )
+    decoder = p["decoder"]
+    _set_cross_attention_layer(sd, "perceiver.decoder.decoder.decoding_cross_attention", decoder["cross_attn"])
+    _set_dense(sd, "perceiver.decoder.decoder.final_layer", decoder["output_adapter"]["linear"])
+
+    model.load_state_dict(sd)
+    return model
+
+
+def export_image_classifier(config, params, save_dir: str) -> None:
+    image_classifier_to_hf(config, params).save_pretrained(save_dir)
+
+
+def export_optical_flow(config, params, save_dir: str) -> None:
+    optical_flow_to_hf(config, params).save_pretrained(save_dir)
+
+
+# ------------------------------------- reference-layout torch state dicts
+# (Perceiver AR families + text classifier: transformers has no architecture
+# for these; the reference publishes them as custom classes whose weights are
+# the backend state dict — reference text/clm/huggingface.py:57-65 and peers)
+
+
+# torch-leaf emitters are the same as the HF layout's (_set_dense/_set_ln);
+# only the key schemes differ
+_ref_dense = _set_dense
+_ref_ln = _set_ln
+
+
+def _ref_attention(sd: Dict, prefix: str, tree: Mapping):
+    for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        _ref_dense(sd, f"{prefix}.{name}", tree[name])
+
+
+def _ref_mlp(sd: Dict, prefix: str, tree: Mapping):
+    # reference MLP Sequential: 0=LayerNorm, 1=Dense(widening), 2=GELU, 3=Dense
+    _ref_ln(sd, f"{prefix}.0", tree["norm"])
+    _ref_dense(sd, f"{prefix}.1", tree["dense_1"])
+    _ref_dense(sd, f"{prefix}.3", tree["dense_2"])
+
+
+def _ref_cross_attention_layer(sd: Dict, prefix: str, tree: Mapping, attention_residual: bool = True):
+    # Sequential(Residual(CrossAttention), Residual(MLP)); no Residual wrapper
+    # (no ``.module`` segment) when attention_residual=False (convert_torch._seq)
+    a = f"{prefix}.0.module" if attention_residual else f"{prefix}.0"
+    ca = tree["cross_attn"]
+    _ref_ln(sd, f"{a}.q_norm", ca["q_norm"])
+    _ref_ln(sd, f"{a}.kv_norm", ca["kv_norm"])
+    _ref_attention(sd, f"{a}.attention", ca["attention"])
+    _ref_mlp(sd, f"{prefix}.1.module", tree["mlp"])
+
+
+def _ref_self_attention_layer(sd: Dict, prefix: str, tree: Mapping):
+    sa = tree["self_attn"]
+    _ref_ln(sd, f"{prefix}.0.module.norm", sa["norm"])
+    _ref_attention(sd, f"{prefix}.0.module.attention", sa["attention"])
+    _ref_mlp(sd, f"{prefix}.1.module", tree["mlp"])
+
+
+def _ref_self_attention_block(sd: Dict, prefix: str, layers: Mapping, num_layers: int):
+    for i in range(num_layers):
+        _ref_self_attention_layer(sd, f"{prefix}.{i}", jax_tree_index(layers, i))
+
+
+def _ref_token_input_adapter(sd: Dict, prefix: str, tree: Mapping):
+    sd[f"{prefix}.txt_embedding.weight"] = _to_torch(tree["txt_embedding"]["embedding"])
+    if "pos_embedding" in tree:
+        sd[f"{prefix}.pos_embedding.weight"] = _to_torch(tree["pos_embedding"]["embedding"])
+
+
+def _ref_encoder(sd: Dict, prefix: str, tree: Mapping, num_layers_per_block: int):
+    sd[f"{prefix}.latent_provider._query"] = _to_torch(tree["latent_provider"]["query"])
+    _ref_cross_attention_layer(sd, f"{prefix}.cross_attn_1", tree["cross_attn_1"])
+    _ref_self_attention_block(sd, f"{prefix}.self_attn_1", tree["self_attn_1"]["layers"], num_layers_per_block)
+    if "cross_attn_n" in tree:
+        _ref_cross_attention_layer(sd, f"{prefix}.cross_attn_n", tree["cross_attn_n"])
+    if "self_attn_n" in tree:
+        _ref_self_attention_block(sd, f"{prefix}.self_attn_n", tree["self_attn_n"]["layers"], num_layers_per_block)
+    if "input_adapter" in tree:
+        adapter = tree["input_adapter"]
+        if "txt_embedding" in adapter:
+            _ref_token_input_adapter(sd, f"{prefix}.input_adapter", adapter)
+        elif "linear" in adapter:
+            _ref_dense(sd, f"{prefix}.input_adapter.linear", adapter["linear"])
+
+
+def causal_sequence_model_to_reference_state_dict(config, params) -> Dict:
+    """Flax CausalSequenceModel / CausalLanguageModel / SymbolicAudioModel params
+    -> reference-layout torch state dict (inverse of
+    convert_torch.causal_sequence_model_params). Missing keys on
+    ``load_state_dict`` are only the reference's recomputed buffers."""
+    p = params["params"]
+    sd: Dict = {}
+    ar = p["ar"]
+    _ref_token_input_adapter(sd, "input_adapter", ar["input_adapter"])
+    _ref_cross_attention_layer(sd, "cross_attention", ar["cross_attention"])
+    _ref_self_attention_block(sd, "self_attention", ar["self_attention"]["layers"], config.num_self_attention_layers)
+    if config.output_norm:
+        _ref_ln(sd, "out_norm", p["out_norm"])
+    if config.output_bias:
+        sd["output_adapter.bias"] = _to_torch(p["output_adapter"]["bias"])
+    return sd
+
+
+# the symbolic audio model is a CausalSequenceModel flavor (reference
+# audio/symbolic/backend.py:11-14); its export is the same mapping
+symbolic_audio_model_to_reference_state_dict = causal_sequence_model_to_reference_state_dict
+
+
+def text_classifier_to_reference_state_dict(config, params) -> Dict:
+    """Flax TextClassifier params -> reference-layout torch state dict (inverse
+    of convert_torch.text_classifier_params). The reference PerceiverIO
+    subclasses are ``nn.Sequential(encoder, decoder)``, so keys use the ``0.`` /
+    ``1.`` prefixes the torch module loads directly (convert_torch
+    _normalize_perceiver_io maps them back on import)."""
+    p = params["params"]
+    sd: Dict = {}
+    _ref_encoder(sd, "0", p["encoder"], config.encoder.num_self_attention_layers_per_block)
+    decoder = p["decoder"]
+    sd["1.output_query_provider._query"] = _to_torch(decoder["output_query_provider"]["query"])
+    _ref_cross_attention_layer(
+        sd, "1.cross_attn", decoder["cross_attn"], attention_residual=config.decoder.cross_attention_residual
+    )
+    _ref_dense(sd, "1.output_adapter.linear", decoder["output_adapter"]["linear"])
+    return sd
+
+
+def export_reference_checkpoint(state_dict: Dict, config, save_dir: str) -> None:
+    """Write a reference-loadable checkpoint directory: ``pytorch_model.bin``
+    (plain torch state dict) + ``config.json`` (the dataclass config). The torch
+    reference loads it with ``model.load_state_dict(torch.load(...))`` after
+    building the model from the config."""
+    import torch
+
+    os.makedirs(save_dir, exist_ok=True)
+    torch.save(state_dict, os.path.join(save_dir, "pytorch_model.bin"))
+    with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(dataclasses.asdict(config), f, indent=2)
+
+
+def export_causal_language_model(config, params, save_dir: str) -> None:
+    export_reference_checkpoint(causal_sequence_model_to_reference_state_dict(config, params), config, save_dir)
+
+
+def export_symbolic_audio_model(config, params, save_dir: str) -> None:
+    export_causal_language_model(config, params, save_dir)
+
+
+def export_text_classifier(config, params, save_dir: str) -> None:
+    export_reference_checkpoint(text_classifier_to_reference_state_dict(config, params), config, save_dir)
+
+
+# ------------------------------------------------------------- CLI plumbing
+
+
+def config_from_dict(family: str, d: Mapping):
+    """Rebuild a model config dataclass from its ``dataclasses.asdict`` JSON form
+    (the layout scripts/convert.py writes next to native checkpoints)."""
+    d = dict(d)
+
+    def sub(cls, key):
+        return cls(**d.pop(key))
+
+    if family == "mlm":
+        from perceiver_io_tpu.models.text.common import TextEncoderConfig
+        from perceiver_io_tpu.models.text.mlm import MaskedLanguageModelConfig, TextDecoderConfig
+
+        return MaskedLanguageModelConfig(
+            encoder=sub(TextEncoderConfig, "encoder"), decoder=sub(TextDecoderConfig, "decoder"), **d
+        )
+    if family == "classifier":
+        from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
+        from perceiver_io_tpu.models.text.classifier import TextClassifierConfig
+        from perceiver_io_tpu.models.text.common import TextEncoderConfig
+
+        return TextClassifierConfig(
+            encoder=sub(TextEncoderConfig, "encoder"), decoder=sub(ClassificationDecoderConfig, "decoder"), **d
+        )
+    if family == "image_classifier":
+        from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
+        from perceiver_io_tpu.models.vision.image_classifier import ImageClassifierConfig, ImageEncoderConfig
+
+        enc = d.pop("encoder")
+        enc["image_shape"] = tuple(enc["image_shape"])
+        return ImageClassifierConfig(
+            encoder=ImageEncoderConfig(**enc), decoder=sub(ClassificationDecoderConfig, "decoder"), **d
+        )
+    if family == "optical_flow":
+        from perceiver_io_tpu.models.vision.optical_flow import (
+            OpticalFlowConfig,
+            OpticalFlowDecoderConfig,
+            OpticalFlowEncoderConfig,
+        )
+
+        enc, dec = d.pop("encoder"), d.pop("decoder")
+        enc["image_shape"] = tuple(enc["image_shape"])
+        dec["image_shape"] = tuple(dec["image_shape"])
+        return OpticalFlowConfig(
+            encoder=OpticalFlowEncoderConfig(**enc), decoder=OpticalFlowDecoderConfig(**dec), **d
+        )
+    if family == "clm":
+        from perceiver_io_tpu.models.text.clm import CausalLanguageModelConfig
+
+        return CausalLanguageModelConfig(**d)
+    if family == "audio":
+        from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModelConfig
+
+        return SymbolicAudioModelConfig(**d)
+    raise ValueError(f"unknown model family {family!r}")
+
+
+EXPORTERS = {
+    "mlm": export_masked_language_model,
+    "classifier": export_text_classifier,
+    "image_classifier": export_image_classifier,
+    "optical_flow": export_optical_flow,
+    "clm": export_causal_language_model,
+    "audio": export_symbolic_audio_model,
+}
+
+
+def export_checkpoint(family: str, checkpoint_dir: str, save_dir: str) -> None:
+    """Export a native checkpoint directory (``params`` orbax dir + ``config.json``,
+    the layout scripts/convert.py writes) into the family's publishing format —
+    the reference's per-task ``convert_checkpoint`` equivalent."""
+    from perceiver_io_tpu.training.checkpoint import load_pytree
+
+    with open(os.path.join(checkpoint_dir, "config.json")) as f:
+        config = config_from_dict(family, json.load(f))
+    params = load_pytree(os.path.join(checkpoint_dir, "params"))
+    EXPORTERS[family](config, params, save_dir)

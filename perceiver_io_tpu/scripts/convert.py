@@ -1,7 +1,14 @@
-"""Model conversion CLI — the reference's examples/convert.py batch driver as a
-command: official DeepMind HF checkpoints -> native orbax params.
+"""Model conversion CLI — the reference's examples/convert.py batch driver plus
+its per-task ``convert_checkpoint`` exporters as one command.
+
+Import (official DeepMind HF checkpoint -> native orbax params):
 
   python -m perceiver_io_tpu.scripts.convert deepmind/language-perceiver out/mlm
+
+Export (native checkpoint dir -> HF save_pretrained dir or reference-layout
+torch checkpoint, depending on family):
+
+  python -m perceiver_io_tpu.scripts.convert --export --family mlm out/mlm hub/mlm
 
 (torch-reference / Lightning checkpoints need a model config and therefore go
 through the perceiver_io_tpu.hf.convert_torch functions directly — see README.)
@@ -16,10 +23,22 @@ import os
 
 
 def main(argv=None):
-    parser = argparse.ArgumentParser(description="Convert official HF Perceiver checkpoints to native params")
-    parser.add_argument("source", help="HF repo id (e.g. deepmind/language-perceiver)")
-    parser.add_argument("output_dir", help="directory for the orbax checkpoint + config.json")
+    parser = argparse.ArgumentParser(description="Convert checkpoints between native and HF/torch formats")
+    parser.add_argument("source", help="HF repo id to import, or (with --export) a native checkpoint dir")
+    parser.add_argument("output_dir", help="output directory")
+    parser.add_argument("--export", action="store_true", help="export a native checkpoint instead of importing")
+    parser.add_argument("--family", help="model family for --export", choices=[
+        "mlm", "classifier", "image_classifier", "optical_flow", "clm", "audio"])
     args = parser.parse_args(argv)
+
+    if args.export:
+        if not args.family:
+            parser.error("--export requires --family")
+        from perceiver_io_tpu.hf.export_hf import export_checkpoint
+
+        export_checkpoint(args.family, args.source, args.output_dir)
+        print(json.dumps({"family": args.family, "source": args.source, "output": args.output_dir}))
+        return
 
     from perceiver_io_tpu.hf.convert_hf import convert_model
     from perceiver_io_tpu.training.checkpoint import save_checkpoint

@@ -40,6 +40,7 @@ def import_reference():
     tv = stub("torchvision", [])
     tv.transforms = stub("torchvision.transforms", ["Compose", "Normalize", "ToTensor", "RandomCrop", "CenterCrop", "Lambda"])
     stub("cv2", [])
+    stub("pretty_midi", ["PrettyMIDI", "Note", "Instrument", "ControlChange"])
 
     import perceiver  # noqa: F401
 
