@@ -12,10 +12,11 @@ or skip data after preemption): ``state_dict()`` captures the RNG state as of
 the current epoch's start plus the number of batches already consumed;
 ``load_state_dict()`` replays the same permutation and skips the consumed
 prefix, so training continues on precisely the next unseen batch. The
-guarantee covers batch ORDER and POSITION (no example repeated or skipped);
-stochastic collator augmentation (dynamic masking, random truncation/shift)
-draws fresh randomness after a restore — give the loader a dedicated RNG, as
-the data modules do.
+guarantee covers batch ORDER and POSITION (no example repeated or skipped) AND
+dataset-side augmentation RNGs (random shift — snapshotted via the ``.dataset``
+chain's own state_dict, since skipped batches are not re-fetched); COLLATOR
+draws (dynamic masking, random truncation) remain fresh randomness after a
+restore — give the loader a dedicated RNG, as the data modules do.
 """
 
 from __future__ import annotations
@@ -49,20 +50,38 @@ class DataLoader:
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
 
+    def _stateful_dataset(self):
+        """First object in the ``.dataset`` wrapper chain carrying its own
+        resume state (e.g. RandomShiftDataset's augmentation RNG)."""
+        obj = self.dataset
+        while obj is not None:
+            if hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict"):
+                return obj
+            obj = getattr(obj, "dataset", None)
+        return None
+
     def state_dict(self) -> Dict:
         """Snapshot for exact resume: the RNG state that produced (or will
-        produce) the current epoch's permutation, and how many of its batches
-        have been consumed."""
-        return {
+        produce) the current epoch's permutation, how many of its batches have
+        been consumed, and any dataset-side state (augmentation RNGs advance per
+        FETCHED example, and restored runs skip batches without fetching)."""
+        out = {
             "rng_state": self._epoch_start_rng_state,
             "batches_consumed": self._consumed,
         }
+        ds = self._stateful_dataset()
+        if ds is not None:
+            out["dataset_state"] = ds.state_dict()
+        return out
 
     def load_state_dict(self, state: Dict) -> None:
         self.rng.bit_generator.state = state["rng_state"]
         self._epoch_start_rng_state = state["rng_state"]
         self._skip = int(state["batches_consumed"])
         self._consumed = self._skip
+        ds = self._stateful_dataset()
+        if ds is not None and "dataset_state" in state:
+            ds.load_state_dict(state["dataset_state"])
 
     def __iter__(self):
         n = len(self.dataset)

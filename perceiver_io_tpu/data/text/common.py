@@ -106,6 +106,15 @@ class RandomShiftDataset:
         self.dataset = dataset
         self.rng = rng if rng is not None else np.random.default_rng()
 
+    def state_dict(self) -> dict:
+        """Augmentation-RNG snapshot: the shift draw advances per fetched
+        example, so exact mid-epoch resume must restore it (the DataLoader
+        replays skipped batches WITHOUT fetching examples)."""
+        return {"rng_state": self.rng.bit_generator.state}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.rng.bit_generator.state = state["rng_state"]
+
     def __len__(self):
         return len(self.dataset) - 1
 
@@ -465,8 +474,9 @@ class TextDataModule:
 
         # the loader gets its OWN generator (spawned off the module seed) so its
         # state_dict/exact-resume covers the batch order independently of the
-        # collators' per-batch draws (dynamic masking/truncation/shift), which
-        # remain fresh randomness after a restore
+        # collators' per-batch draws (dynamic masking/truncation), which remain
+        # fresh randomness after a restore; the shift augmentation's RNG IS
+        # resume-exact (RandomShiftDataset.state_dict via the loader snapshot)
         loader_rng = np.random.default_rng(self._rng.integers(0, 2**63))
         return DataLoader(dataset, batch_size, collate_fn=collate, shuffle=shuffle, drop_last=drop_last, rng=loader_rng)
 

@@ -123,7 +123,8 @@ def _generate_beam(model, params, input_ids, pad_mask, rng, *, prefix_len: int, 
     finished0 = jnp.zeros((b, k), bool)
     finish_step0 = jnp.full((b, k), config.max_new_tokens, jnp.int32)  # step at which EOS fired
 
-    def body(carry, step):
+    def body(carry, xs):
+        step, step_rng = xs
         cache, next_logits, scores, tokens, finished, finish_step = carry
         logp = jax.nn.log_softmax(
             process_logits(next_logits, config.temperature, config.top_k, config.top_p), axis=-1
@@ -132,7 +133,16 @@ def _generate_beam(model, params, input_ids, pad_mask, rng, *, prefix_len: int, 
         pad_only = jnp.full((vocab,), -jnp.inf).at[config.pad_token_id].set(0.0)
         logp = jnp.where(finished[..., None], pad_only[None, None, :], logp)
         cand = scores[..., None] + logp  # (B, K, V)
-        top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)  # (B, K)
+        flat = cand.reshape(b, k * vocab)
+        if config.do_sample:
+            # beam-multinomial (HF beam_sample): draw K continuations without
+            # replacement, proportional to exp(beam score + logp) — exact via
+            # the Gumbel-top-k trick; beam scores accumulate the TRUE log-probs
+            gumbel = jax.random.gumbel(step_rng, flat.shape)
+            _, top_idx = jax.lax.top_k(jnp.where(jnp.isfinite(flat), flat + gumbel, flat), k)
+            top_scores = jnp.take_along_axis(flat, top_idx, axis=1)  # (B, K)
+        else:
+            top_scores, top_idx = jax.lax.top_k(flat, k)  # (B, K)
         beam_idx = top_idx // vocab
         tok = (top_idx % vocab).astype(jnp.int32)
 
@@ -151,9 +161,8 @@ def _generate_beam(model, params, input_ids, pad_mask, rng, *, prefix_len: int, 
         return (cache, logits_t[:, -1], top_scores, tokens, finished, finish_step), None
 
     carry0 = (cache, next_logits, scores0, tokens0, finished0, finish_step0)
-    (cache, _, scores, tokens, finished, finish_step), _ = jax.lax.scan(
-        body, carry0, jnp.arange(config.max_new_tokens)
-    )
+    xs = (jnp.arange(config.max_new_tokens), jax.random.split(rng, config.max_new_tokens))
+    (cache, _, scores, tokens, finished, finish_step), _ = jax.lax.scan(body, carry0, xs)
     # pick best beam (scores already include finished freezing); length penalty
     # uses the recorded finish step, not a token-value heuristic
     lengths = finish_step.clip(1)
@@ -260,7 +269,7 @@ def generate(
             raise ValueError("temperature/top_p have no effect in contrastive search; leave them at defaults")
         return _generate_contrastive(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
     if config.num_beams > 1:
-        if config.do_sample:
-            raise ValueError("beam-multinomial sampling (num_beams > 1 with do_sample) is not supported yet")
+        # do_sample=False: classic beam search; do_sample=True: beam-multinomial
+        # (HF GenerationMixin beam_sample, reference core/huggingface.py:187-230)
         return _generate_beam(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
     return _generate_single(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)

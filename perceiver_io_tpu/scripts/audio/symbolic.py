@@ -45,6 +45,7 @@ def main(argv=None):
     cli.add_group("model", SymbolicAudioModelConfig, MODEL_DEFAULTS)
     cli.add_group("optimizer", OptimizerFlags, dict(lr=2e-4, warmup_steps=500, schedule="cosine", max_grad_norm=0.5))
     cli.add_group("trainer", TrainerConfig, dict(max_steps=100000, checkpoint_dir="ckpts/sam"))
+    cli.add_bool_flag("resume", help="continue from <checkpoint_dir>/last (state + exact data position)")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -82,6 +83,7 @@ def main(argv=None):
         make_causal_lm_train_step(model, tx, max_latents=config.max_latents),
         data,
         eval_step=make_causal_lm_eval_step(eval_model, max_latents=config.max_latents),
+        resume=args.resume,
     )
 
 

@@ -82,12 +82,38 @@ def run_fit(
     data_module,
     eval_step: Optional[Callable] = None,
     on_eval: Optional[Callable] = None,
+    resume: bool = False,
 ) -> TrainState:
+    """``resume=True`` continues a killed/finished run from
+    ``<checkpoint_dir>/last``: the full TrainState (params, optimizer moments,
+    step, rng) is restored, and — when the loader is stateful — the exact
+    mid-epoch data position from ``last_iterator.json``, so training continues
+    bit-exact from the next unseen batch (a stronger guarantee than the
+    reference's Lightning restart, which replays the epoch)."""
+    import json
+
     trainer = Trainer(trainer_cfg)
+    train_loader_fn = data_module.train_dataloader
+    if resume and trainer_cfg.checkpoint_dir:
+        last = os.path.join(trainer_cfg.checkpoint_dir, "last")
+        if os.path.isdir(last):
+            # a shape-only template — restoring must not materialize a second
+            # full state (the factory form exists to avoid that memory peak)
+            template = jax.eval_shape(state) if callable(state) else state
+            state = Trainer.restore(last, template)
+            it_path = os.path.join(trainer_cfg.checkpoint_dir, "last_iterator.json")
+            if os.path.exists(it_path):
+                loader = data_module.train_dataloader()
+                if hasattr(loader, "load_state_dict"):
+                    Trainer.restore_iterator(it_path, loader)
+                    train_loader_fn = lambda: loader
+            print(json.dumps({"resumed_from_step": int(state.step)}))
+        else:
+            print(json.dumps({"resume": "no checkpoint at " + last + "; starting fresh"}))
     return trainer.fit(
         state,
         train_step,
-        train_loader_fn=data_module.train_dataloader,
+        train_loader_fn=train_loader_fn,
         eval_step=eval_step,
         eval_loader_fn=data_module.val_dataloader if eval_step else None,
         on_eval=on_eval,

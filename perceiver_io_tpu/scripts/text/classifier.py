@@ -39,6 +39,7 @@ def main(argv=None):
     cli.add_group("trainer", TrainerConfig, dict(max_steps=10000, checkpoint_dir="ckpts/txt_clf", monitor="acc", monitor_mode="max"))
     cli.add_flag("mlm_checkpoint", help="orbax checkpoint dir of a trained MLM for encoder warm start")
     cli.add_flag("resume_checkpoint", help="orbax checkpoint dir of a stage-1 classifier run to fine-tune from")
+    cli.add_bool_flag("resume", help="continue from <checkpoint_dir>/last (state + exact data position)")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -80,6 +81,7 @@ def main(argv=None):
         make_classifier_train_step(model, tx, input_key="input_ids", label_key="labels"),
         data,
         eval_step=make_classifier_eval_step(eval_model, input_key="input_ids", label_key="labels"),
+        resume=args.resume,
     )
 
 

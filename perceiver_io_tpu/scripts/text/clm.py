@@ -55,6 +55,7 @@ def main(argv=None):
     cli.add_group("optimizer", OptimizerFlags, OPT_DEFAULTS)
     cli.add_group("trainer", TrainerConfig, dict(max_steps=20000, checkpoint_dir="ckpts/clm"))
     cli.add_flag("sample_prompt", default="A man", help="prompt used for per-eval sample generation")
+    cli.add_bool_flag("resume", help="continue from <checkpoint_dir>/last (state + exact data position)")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -103,6 +104,7 @@ def main(argv=None):
         data,
         eval_step=make_causal_lm_eval_step(eval_model, max_latents=config.max_latents),
         on_eval=on_eval,
+        resume=args.resume,
     )
 
 

@@ -60,6 +60,7 @@ def main(argv=None):
         default="I have watched this <mask> and it was awesome.",
         help="'|'-separated masked texts filled and logged at each eval",
     )
+    cli.add_bool_flag("resume", help="continue from <checkpoint_dir>/last (state + exact data position)")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -100,7 +101,7 @@ def main(argv=None):
         )
         print(json.dumps({"filled_samples": filled}))
 
-    run_fit(trainer_cfg, state, make_mlm_train_step(model, tx), data, eval_step=eval_step, on_eval=on_eval)
+    run_fit(trainer_cfg, state, make_mlm_train_step(model, tx), data, eval_step=eval_step, on_eval=on_eval, resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -54,6 +54,7 @@ def main(argv=None):
     cli.add_group("decoder", ClassificationDecoderConfig, DECODER_DEFAULTS)
     cli.add_group("optimizer", OptimizerFlags, dict(lr=1e-3, warmup_steps=500, schedule="constant"))
     cli.add_group("trainer", TrainerConfig, dict(max_steps=15000, eval_every=500, checkpoint_dir="ckpts/img_clf", monitor="acc", monitor_mode="max"))
+    cli.add_bool_flag("resume", help="continue from <checkpoint_dir>/last (state + exact data position)")
     args = cli.parse()
 
     data = cli.build("data", args)
@@ -81,6 +82,7 @@ def main(argv=None):
         make_classifier_train_step(model, tx),
         data,
         eval_step=make_classifier_eval_step(eval_model),
+        resume=args.resume,
     )
 
 

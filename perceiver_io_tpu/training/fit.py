@@ -39,6 +39,9 @@ class TrainerConfig:
     max_steps: int = 1000
     eval_every: int = 200
     log_every: int = 50
+    checkpoint_every: int = 0  # periodically overwrite <checkpoint_dir>/last (+ iterator
+    # snapshot) every N steps so a kill/preemption mid-run leaves a resume point;
+    # 0 = only at eval-best and completion
     checkpoint_dir: Optional[str] = None
     monitor: str = "loss"  # validation metric selecting the best checkpoint
     monitor_mode: str = "min"
@@ -118,6 +121,10 @@ class Trainer:
                     self.history.append(line)
                     self.log(json.dumps(line))
                     window_t0, window_steps = time.perf_counter(), 0
+
+                if cfg.checkpoint_dir and cfg.checkpoint_every and step_count % cfg.checkpoint_every == 0:
+                    save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
+                    self._save_iterator_state("last_iterator.json")
 
                 if eval_fn is not None and step_count % cfg.eval_every == 0:
                     val = self.evaluate(state, eval_fn, eval_loader_fn(), put)

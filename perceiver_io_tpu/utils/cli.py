@@ -130,6 +130,19 @@ class CLI:
     def add_flag(self, name: str, default=None, help: str = ""):
         self.parser.add_argument(f"--{name}", type=str, default=default, help=help)
 
+    def add_bool_flag(self, name: str, default: bool = False, help: str = ""):
+        def parse_bool(s: str) -> bool:
+            low = s.lower()
+            if low in ("1", "true", "yes"):
+                return True
+            if low in ("0", "false", "no"):
+                return False
+            raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+        self.parser.add_argument(
+            f"--{name}", type=parse_bool, nargs="?", const=True, default=default, help=help
+        )
+
     def parse(self) -> argparse.Namespace:
         return self.parser.parse_args(self.argv)
 

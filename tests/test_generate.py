@@ -127,6 +127,31 @@ def test_beam_search(setup):
     np.testing.assert_array_equal(np.asarray(out[:, :8]), np.asarray(prompt))
 
 
+def test_beam_multinomial_sampling(setup):
+    """do_sample=True with num_beams > 1 (HF beam_sample): reproducible under a
+    fixed key, key-sensitive, and distinct from deterministic beam search."""
+    model, params, x = setup
+    prompt = x[:, :8]
+    cfg = GenerationConfig(max_new_tokens=8, num_beams=2, do_sample=True, temperature=1.5)
+    a = generate(model, params, prompt, num_latents=4, rng=jax.random.PRNGKey(3), config=cfg)
+    b = generate(model, params, prompt, num_latents=4, rng=jax.random.PRNGKey(3), config=cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same key -> same tokens
+    assert a.shape == (2, 16)
+    np.testing.assert_array_equal(np.asarray(a[:, :8]), np.asarray(prompt))
+
+    outs = {
+        np.asarray(generate(model, params, prompt, num_latents=4, rng=jax.random.PRNGKey(s), config=cfg)).tobytes()
+        for s in range(8)
+    }
+    assert len(outs) > 1  # sampling actually samples across keys
+    beam = generate(
+        model, params, prompt, num_latents=4, config=GenerationConfig(max_new_tokens=8, num_beams=2)
+    )
+    assert any(
+        o != np.asarray(beam).tobytes() for o in outs
+    )  # and deviates from deterministic beam search
+
+
 def test_cached_equals_uncached_growth_regime(x64):
     """Greedy cached generate must match a token-by-token uncached loop while the
     latent count grows (prefix fixed) — exact in float64."""
