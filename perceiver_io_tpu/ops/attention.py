@@ -213,15 +213,20 @@ class MultiHeadAttention(nn.Module):
             k = apply_rope(k, rope_k)
 
         # Sequence-parallel path: ring attention over the configured mesh axis
-        # (long-context training; queries and keys sharded over `seq`).
+        # (long-context training; queries and keys sharded over `seq`). With
+        # attention dropout the differentiable einsum ring runs with a
+        # position-keyed mask; without it the custom-VJP ring (splash blocks on
+        # TPU, O(n/S) backward memory) is used.
         if self.seq_axis is not None and kv_cache is None:
-            if has_dropout:
-                raise ValueError("attention dropout is not supported on the ring-attention path")
             from perceiver_io_tpu.parallel.ring_attention import ring_attention_ambient
 
             if q.shape[0] != k.shape[0]:
                 q = jnp.broadcast_to(q, (k.shape[0], *q.shape[1:]))
-            o = ring_attention_ambient(q, k, v, pad_mask=pad_mask, causal=self.causal_attention, seq_axis=self.seq_axis)
+            o = ring_attention_ambient(
+                q, k, v, pad_mask=pad_mask, causal=self.causal_attention, seq_axis=self.seq_axis,
+                dropout_rate=self.dropout if has_dropout else 0.0,
+                dropout_rng=self.make_rng("dropout") if has_dropout else None,
+            )
             o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
             return self.o_proj(o), kv_cache
 

@@ -30,14 +30,17 @@ _BLOCK = 512
 
 
 def decode_kernel_supported(n_q: int, capacity: int, num_qk: int, num_v: int, num_heads: int = 1) -> bool:
-    """Single-token cached decode on one TPU chip with symmetric qk/v widths and
-    a block-tileable cache. Kill-switch: PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL."""
+    """Short-query cached decode on one TPU chip with symmetric qk/v widths and
+    a block-tileable cache. ``n_q > 1`` covers multi-query decode (speculative /
+    chunked verification); each query keeps its flash stats in its own scratch
+    row, so n_q is bounded by the 8-sublane scratch tile.
+    Kill-switch: PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL."""
     if os.environ.get("PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL", "0").lower() not in ("0", "false", ""):
         return False
     if jax.default_backend() != "tpu" or jax.device_count() > 1:
         return False
     return (
-        n_q == 1
+        1 <= n_q <= 8  # one (8, 128) scratch sublane of running stats per query
         and num_qk == num_v
         and num_heads <= 128  # per-head stats live in one (8, 128) scratch row
         and capacity % min(_BLOCK, capacity) == 0
@@ -71,21 +74,24 @@ def _head_expander(h: int, d: int):
 def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref):
     """Grid (B, num_blocks); block i covers cache slots [i*blk, (i+1)*blk).
 
-    qpos_ref (B,)            absolute query positions (scalar-prefetch, SMEM)
-    qbd_ref  (h*d, h)        block-diagonal scaled+rotated query (col head holds q_head)
+    qpos_ref (B,)            absolute position of the LAST query (scalar-prefetch, SMEM)
+    qbd_ref  (h*d, n_q*h)    block-diagonal scaled+rotated queries (col qi*h+head
+                             holds query qi's head slice in rows [head*d, (head+1)*d))
     k_ref    (1, blk, h*d)   unrotated keys
     v_ref    (1, blk, h*d)   values
     ang_ref  (1, blk, r)     rotary angles per slot (pairwise-repeated)
     pad_ref  (1, blk, 1)     pad-slot mask (int8, 1 = pad)
     rot_ref  (h*d, h*d)      block-diag rotate-half matrix
     exp_ref  (h, h*d)        head->channel expander
-    o_ref    (1, 1, h*d)     output
-    scratch: m, l (8, 128) VMEM (running per-head stats in row 0), acc (8, h*d)
+    o_ref    (1, n_q, h*d)   output
+    scratch: m, l (8, 128) VMEM (query qi's per-head stats in row qi), acc (8, h*d)
+                             (query qi's output accumulator in row qi)
 
     Everything is a full-width 2D op: the rotate and score contractions are
-    single (blk, h*d) matmuls covering all heads (MXU-shaped, no per-head
-    slicing), and softmax stats live in (1, h) rows that broadcast over
-    sublanes — the orientations Mosaic lowers natively.
+    single (blk, h*d) matmuls covering all heads and all queries (MXU-shaped, no
+    per-head slicing), and softmax stats live in (1, h) rows that broadcast over
+    sublanes — the orientations Mosaic lowers natively. The per-query loop is a
+    trace-time Python unroll over static scratch rows (n_q <= 8).
     """
     import jax.experimental.pallas as pl
 
@@ -93,7 +99,9 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
     i = pl.program_id(1)
     nblocks = pl.num_programs(1)
     blk = k_ref.shape[1]
-    hd, h = qbd_ref.shape
+    hd = k_ref.shape[2]
+    h = exp_ref.shape[0]
+    n_q = qbd_ref.shape[1] // h
     r = ang_ref.shape[2]
     d = hd // h
 
@@ -114,31 +122,39 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
     rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
     k = k * cos + rot_half * sin
 
-    sc = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h)
-    q_pos = qpos_ref[bi]
+    sc_all = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, n_q*h)
+    q_last = qpos_ref[bi]
     slot = i * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
-    visible = (slot <= q_pos) & (pad_ref[0].astype(jnp.int32) == 0)  # (blk, 1)
-    sc = jnp.where(visible, sc, -jnp.inf)
+    not_pad = pad_ref[0].astype(jnp.int32) == 0  # (blk, 1)
+    vf = v_ref[0].astype(jnp.float32)
 
-    m_prev = m_ref[:1, :h]
-    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
-    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
-    prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (blk, h)
+    for qi in range(n_q):
+        sc = sc_all[:, qi * h : (qi + 1) * h]  # (blk, h)
+        visible = (slot <= q_last - (n_q - 1 - qi)) & not_pad  # (blk, 1)
+        sc = jnp.where(visible, sc, -jnp.inf)
 
-    prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h*d)
-    pv = jnp.sum(prob_x * v_ref[0].astype(jnp.float32), axis=0, keepdims=True)  # (1, h*d)
-    scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (1, h*d)
+        m_prev = m_ref[qi : qi + 1, :h]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
+        prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (blk, h)
 
-    m_ref[:1, :h] = m_new
-    l_ref[:1, :h] = l_ref[:1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
-    acc_ref[:1, :] = acc_ref[:1, :] * scale_x + pv
+        prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h*d)
+        pv = jnp.sum(prob_x * vf, axis=0, keepdims=True)  # (1, h*d)
+        scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (1, h*d)
+
+        m_ref[qi : qi + 1, :h] = m_new
+        l_ref[qi : qi + 1, :h] = l_ref[qi : qi + 1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
+        acc_ref[qi : qi + 1, :] = acc_ref[qi : qi + 1, :] * scale_x + pv
 
     @pl.when(i == nblocks - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:1, :h], 1e-30)
-        l_x = jax.lax.dot_general(1.0 / l, exp_ref[:], contract, preferred_element_type=jnp.float32)
-        o_ref[0] = (acc_ref[:1, :] * l_x).astype(o_ref.dtype)
+        rows = []
+        for qi in range(n_q):
+            l = jnp.maximum(l_ref[qi : qi + 1, :h], 1e-30)
+            l_x = jax.lax.dot_general(1.0 / l, exp_ref[:], contract, preferred_element_type=jnp.float32)
+            rows.append(acc_ref[qi : qi + 1, :] * l_x)
+        o_ref[0] = (rows[0] if n_q == 1 else jnp.concatenate(rows, axis=0)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -151,27 +167,30 @@ def fused_decode_attention(
     pad_slots: jax.Array,
     interpret: bool = False,
 ) -> jax.Array:
-    """q (B, H, 1, D) scaled (+rotated) query; k/v_cache (B, cap, H*D) unrotated;
-    rope_k (B, cap, R) angles; q_pos () or (B,) absolute query position;
-    pad_slots (B, cap). Returns (B, H, 1, D)."""
+    """q (B, H, n_q, D) scaled (+rotated) queries, n_q <= 8; k/v_cache
+    (B, cap, H*D) unrotated; rope_k (B, cap, R) angles; q_pos () or (B,)
+    absolute position of the LAST query (query qi sits at q_pos - (n_q-1-qi));
+    pad_slots (B, cap). Returns (B, H, n_q, D)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, _, d = q.shape
+    b, h, n_q, d = q.shape
     cap = k_cache.shape[1]
     blk = min(_BLOCK, cap)
     nblocks = cap // blk
     r = rope_k.shape[-1]
 
     q_pos_arr = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
-    # block-diagonal query: column `head` carries q[head] in rows [head*d, (head+1)*d)
-    qbd = (q.reshape(b, h, d).transpose(0, 2, 1)[:, None, :, :] * jnp.eye(h, dtype=q.dtype)[:, None, :]).reshape(b, h * d, h)
+    # block-diagonal queries: column qi*h+head carries q[:, head, qi] in rows
+    # [head*d, (head+1)*d)
+    eye = jnp.eye(h, dtype=q.dtype)
+    qbd = (q.transpose(0, 1, 3, 2)[:, :, :, :, None] * eye[:, None, None, :]).reshape(b, h * d, n_q * h)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, nblocks),
         in_specs=[
-            pl.BlockSpec((None, h * d, h), lambda bi, i, *_: (bi, 0, 0)),
+            pl.BlockSpec((None, h * d, n_q * h), lambda bi, i, *_: (bi, 0, 0)),
             pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
             pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
             pl.BlockSpec((1, blk, r), lambda bi, i, *_: (bi, i, 0)),
@@ -179,7 +198,7 @@ def fused_decode_attention(
             pl.BlockSpec((h * d, h * d), lambda bi, i, *_: (0, 0)),
             pl.BlockSpec((h, h * d), lambda bi, i, *_: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, h * d), lambda bi, i, *_: (bi, 0, 0)),
+        out_specs=pl.BlockSpec((1, n_q, h * d), lambda bi, i, *_: (bi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((8, 128), jnp.float32),
             pltpu.VMEM((8, 128), jnp.float32),
@@ -189,7 +208,7 @@ def fused_decode_attention(
     out = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, n_q, h * d), q.dtype),
         interpret=interpret,
     )(
         q_pos_arr,
@@ -201,4 +220,4 @@ def fused_decode_attention(
         jnp.asarray(_rotate_half_blockdiag(h, d, r)),
         jnp.asarray(_head_expander(h, d)),
     )
-    return out.reshape(b, h, d)[:, :, None, :]
+    return out.reshape(b, n_q, h, d).transpose(0, 2, 1, 3)

@@ -117,13 +117,14 @@ def _pick_block(n_q: int, n_k: int, head_dim: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _kernel(num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool):
+def _kernel(num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool,
+            save_residuals: bool = False):
     import jax.experimental.pallas.ops.tpu.splash_attention as sa
 
     # This is usually reached inside a jit trace; mask-info preprocessing must
     # produce concrete arrays (they get cached), not tracers.
     with jax.ensure_compile_time_eval():
-        return _build_kernel(sa, num_heads, n_q, n_k, block, causal, interpret)
+        return _build_kernel(sa, num_heads, n_q, n_k, block, causal, interpret, save_residuals)
 
 
 def _resolve_block(n_q: int, n_k: int, head_dim: int) -> int:
@@ -136,7 +137,8 @@ def _resolve_block(n_q: int, n_k: int, head_dim: int) -> int:
     return block
 
 
-def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool):
+def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, block: int, causal: bool, interpret: bool,
+                  save_residuals: bool = False):
     if causal:
         # right-aligned causal: query row i sees keys 0..(n_k - n_q + i)
         head_mask = sa.CausalMask((n_q, n_k), offset=n_k - n_q)
@@ -148,7 +150,13 @@ def _build_kernel(sa, num_heads: int, n_q: int, n_k: int, block: int, causal: bo
         block_q_dkv=block, block_kv_dkv=block, block_kv_dkv_compute=block,
         block_q_dq=block, block_kv_dq=block,
     )
-    return sa.make_splash_mha(mask, head_shards=1, q_seq_shards=1, block_sizes=bs, interpret=interpret)
+    # save_residuals returns (out, (logsumexp,)) — the ring-attention merge
+    # needs the block logsumexp; that path wraps the call in its own custom-VJP
+    # (splash's residuals output is forward-only).
+    return sa.make_splash_mha(
+        mask, head_shards=1, q_seq_shards=1, block_sizes=bs,
+        save_residuals=save_residuals, interpret=interpret,
+    )
 
 
 def splash_mha(

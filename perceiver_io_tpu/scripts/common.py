@@ -94,20 +94,39 @@ def run_fit(
 
     trainer = Trainer(trainer_cfg)
     train_loader_fn = data_module.train_dataloader
+    initial_best = None
     if resume and trainer_cfg.checkpoint_dir:
         last = os.path.join(trainer_cfg.checkpoint_dir, "last")
         if os.path.isdir(last):
             # a shape-only template — restoring must not materialize a second
             # full state (the factory form exists to avoid that memory peak)
             template = jax.eval_shape(state) if callable(state) else state
-            state = Trainer.restore(last, template)
+            if trainer_cfg.mesh_axes:
+                # restore each array straight into its sharded device layout —
+                # never materializing the full unsharded state on one host
+                from perceiver_io_tpu.parallel.api import _infer_state_shardings
+                from perceiver_io_tpu.parallel.mesh import make_mesh
+                from perceiver_io_tpu.training.checkpoint import restore_checkpoint
+
+                mesh = make_mesh(trainer_cfg.mesh_axes)
+                state_sh = _infer_state_shardings(template, mesh, trainer_cfg.parallel_mode, 2**12)
+                state = restore_checkpoint(last, template, shardings=state_sh)
+            else:
+                state = Trainer.restore(last, template)
             it_path = os.path.join(trainer_cfg.checkpoint_dir, "last_iterator.json")
             if os.path.exists(it_path):
                 loader = data_module.train_dataloader()
                 if hasattr(loader, "load_state_dict"):
                     Trainer.restore_iterator(it_path, loader)
                     train_loader_fn = lambda: loader
-            print(json.dumps({"resumed_from_step": int(state.step)}))
+            best_path = os.path.join(trainer_cfg.checkpoint_dir, "best_metric.json")
+            if os.path.exists(best_path):
+                with open(best_path) as f:
+                    best_rec = json.load(f)
+                # only comparable if the run monitors the same metric
+                if best_rec.get("monitor") == trainer_cfg.monitor:
+                    initial_best = float(best_rec["value"])
+            print(json.dumps({"resumed_from_step": int(state.step), "best": initial_best}))
         else:
             print(json.dumps({"resume": "no checkpoint at " + last + "; starting fresh"}))
     return trainer.fit(
@@ -117,4 +136,5 @@ def run_fit(
         eval_step=eval_step,
         eval_loader_fn=data_module.val_dataloader if eval_step else None,
         on_eval=on_eval,
+        initial_best=initial_best,
     )

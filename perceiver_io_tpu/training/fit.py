@@ -66,6 +66,7 @@ class Trainer:
         eval_step: Optional[Callable] = None,
         eval_loader_fn: Optional[Callable[[], Iterable]] = None,
         on_eval: Optional[Callable[[TrainState, Dict], None]] = None,
+        initial_best: Optional[float] = None,
     ) -> TrainState:
         """``state`` may be a materialized TrainState or a zero-arg factory
         (``lambda: TrainState.create(model.init(...), tx)``). With ``mesh_axes``
@@ -91,7 +92,10 @@ class Trainer:
             eval_fn = jax.jit(eval_step) if eval_step else None
             put = lambda b: b
 
-        best = None
+        # ``initial_best`` carries the monitor value of an earlier run's best
+        # checkpoint across a resume — without it the first post-resume eval
+        # would overwrite <checkpoint_dir>/best even when it is worse.
+        best = initial_best
         step_count = int(state.step)
         window_t0, window_steps = time.perf_counter(), 0
         # A stateful (resumable) loader is obtained ONCE and re-iterated per
@@ -186,6 +190,13 @@ class Trainer:
             save_checkpoint(os.path.join(cfg.checkpoint_dir, "best"), state)
             # keep the iterator snapshot in lockstep with the weights it pairs with
             self._save_iterator_state("best_iterator.json")
+            # persist the monitor value so a resumed run keeps competing
+            # against this best instead of overwriting it unconditionally
+            path = os.path.join(cfg.checkpoint_dir, "best_metric.json")
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"monitor": cfg.monitor, "value": float(value)}, f)
+            os.replace(tmp, path)
             self.log(json.dumps({"checkpoint": "best", cfg.monitor: round(value, 5)}))
             return value
         return best

@@ -108,6 +108,34 @@ def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
     assert int(restored.step) == 50
 
 
+def test_best_metric_survives_resume(tmp_path):
+    """A resumed run must keep competing against the previous run's best
+    checkpoint: _maybe_checkpoint persists the monitor value, fit(initial_best=)
+    restores it, and a worse post-resume eval does NOT overwrite 'best'."""
+    init_fn, tx, train_step, eval_step, loader = tiny_fit_setup()
+    state = TrainState.create(init_fn(), tx)
+    trainer = Trainer(
+        TrainerConfig(max_steps=50, eval_every=10, log_every=50, checkpoint_dir=str(tmp_path)),
+        log_fn=lambda line: None,
+    )
+    trainer.fit(state, train_step, loader, eval_step=eval_step, eval_loader_fn=loader)
+    with open(tmp_path / "best_metric.json") as f:
+        rec = json.load(f)
+    assert rec["monitor"] == "loss" and rec["value"] > 0
+
+    best_mtime = os.path.getmtime(tmp_path / "best")
+    # resume-style second fit whose evals are all worse than the saved best:
+    # with initial_best threaded, 'best' must NOT be overwritten
+    state2 = TrainState.create(init_fn(), tx)  # fresh (bad) params
+    trainer2 = Trainer(
+        TrainerConfig(max_steps=10, eval_every=5, log_every=50, checkpoint_dir=str(tmp_path)),
+        log_fn=lambda line: None,
+    )
+    trainer2.fit(state2, train_step, loader, eval_step=eval_step, eval_loader_fn=loader,
+                 initial_best=rec["value"])
+    assert os.path.getmtime(tmp_path / "best") == best_mtime
+
+
 def test_trainer_fit_accepts_state_factory_on_mesh():
     """fit() with a zero-arg TrainState factory + mesh_axes initializes directly
     sharded (jitted init with out_shardings, no host-resident full copy)."""

@@ -14,12 +14,15 @@ from perceiver_io_tpu.ops.position import apply_rope
 
 
 def xla_reference(q, k_cache, v_cache, ang, q_pos, pad):
-    b, h, _, d = q.shape
+    """q_pos is the LAST query's absolute position; query qi sits at
+    q_pos - (n_q - 1 - qi) (the kernel's multi-query convention)."""
+    b, h, n_q, d = q.shape
     cap = k_cache.shape[1]
     kh = apply_rope(k_cache.reshape(b, cap, h, d).transpose(0, 2, 1, 3).astype(jnp.float32), ang)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kh)
-    visible = (jnp.arange(cap)[None, :] <= jnp.asarray(q_pos).reshape(-1, 1)) & ~pad
-    s = jnp.where(visible[:, None, None, :], s, -jnp.inf)
+    qpos = jnp.asarray(q_pos).reshape(-1, 1) - (n_q - 1) + jnp.arange(n_q)  # (b, n_q)
+    visible = (jnp.arange(cap)[None, None, :] <= qpos[:, :, None]) & ~pad[:, None, :]
+    s = jnp.where(visible[:, None, :, :], s, -jnp.inf)
     vh = v_cache.reshape(b, cap, h, d).transpose(0, 2, 1, 3).astype(jnp.float32)
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vh)
 
@@ -57,6 +60,75 @@ def test_fused_decode_attention_per_batch_positions():
     out = dk.fused_decode_attention(q, k, v, ang, q_pos, pad, interpret=True)
     ref = xla_reference(q, k, v, ang, q_pos, pad)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "b,h,d,cap,r,n_q,q_last",
+    [
+        (2, 4, 64, 1024, 32, 4, 700),  # multi-block, partial rotary, mid-cache
+        (1, 2, 32, 256, 32, 8, 7),     # max n_q, queries at the very start
+        (2, 2, 16, 128, 8, 2, 127),    # full cache visible to the last query
+    ],
+)
+def test_fused_decode_attention_multi_query(b, h, d, cap, r, n_q, q_last):
+    """n_q > 1 (speculative / chunked decode): each query gets its own causal
+    bound q_last - (n_q-1-qi) and its own flash-stats scratch row."""
+    rng = lambda i: jax.random.PRNGKey(i)
+    q = jax.random.normal(rng(0), (b, h, n_q, d)) * 0.3
+    k = jax.random.normal(rng(1), (b, cap, h * d)) * 0.3
+    v = jax.random.normal(rng(2), (b, cap, h * d)) * 0.3
+    ang = jnp.repeat(jax.random.normal(rng(3), (b, cap, r // 2)) * 0.5, 2, axis=-1)
+    pad = jnp.zeros((b, cap), bool).at[:, 1:2].set(True)
+
+    out = dk.fused_decode_attention(q, k, v, ang, jnp.asarray(q_last), pad, interpret=True)
+    ref = xla_reference(q, k, v, ang, jnp.full((b,), q_last), pad)
+    assert out.shape == (b, h, n_q, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_decode_attention_multi_query_per_batch_positions():
+    b, h, d, cap, r, n_q = 2, 2, 32, 256, 16, 3
+    rng = lambda i: jax.random.PRNGKey(i)
+    q = jax.random.normal(rng(0), (b, h, n_q, d)) * 0.3
+    k = jax.random.normal(rng(1), (b, cap, h * d)) * 0.3
+    v = jax.random.normal(rng(2), (b, cap, h * d)) * 0.3
+    ang = jnp.repeat(jax.random.normal(rng(3), (b, cap, r // 2)) * 0.5, 2, axis=-1)
+    pad = jnp.zeros((b, cap), bool)
+    q_last = jnp.asarray([5, 200], jnp.int32)
+    out = dk.fused_decode_attention(q, k, v, ang, q_last, pad, interpret=True)
+    ref = xla_reference(q, k, v, ang, q_last, pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_cached_multi_token_attention_with_kernel_matches_plain(monkeypatch):
+    """MultiHeadAttention cached causal path with an n_q=4 chunk (chunked decode
+    verification): forcing the fused kernel (interpret) must match kernel-off."""
+    from perceiver_io_tpu.ops.attention import KVCache, MultiHeadAttention
+
+    b, n_ctx, n_q, ch, heads = 2, 8, 4, 32, 2
+    mha = MultiHeadAttention(
+        num_heads=heads, num_q_input_channels=ch, num_kv_input_channels=ch, causal_attention=True
+    )
+    rng = jax.random.PRNGKey(0)
+    x_ctx = jax.random.normal(rng, (b, n_ctx, ch)) * 0.3
+    x_new = jax.random.normal(jax.random.PRNGKey(1), (b, n_q, ch)) * 0.3
+    params = mha.init(rng, x_ctx, x_ctx)
+    real_fused = dk.fused_decode_attention
+
+    def run(force_kernel):
+        if force_kernel:
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a: 1 <= n_q <= 8)
+            monkeypatch.setattr(dk, "fused_decode_attention", lambda *a, **kw: real_fused(*a, interpret=True))
+        else:
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda *a: False)
+        cache = KVCache.create(b, 16, ch, ch)
+        out0, cache = mha.apply(params, x_ctx, x_ctx, kv_cache=cache)
+        out1, cache = mha.apply(params, x_new, x_new, kv_cache=cache)
+        return np.asarray(out1)
+
+    plain = run(False)
+    fused = run(True)
+    np.testing.assert_allclose(fused, plain, atol=2e-5)
 
 
 def test_decode_kernel_supported_gates():
