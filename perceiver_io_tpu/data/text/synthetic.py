@@ -1,0 +1,178 @@
+"""Synthetic / locally-sourced text corpora for zero-egress convergence runs.
+
+The reference proves its CLM recipes against WikiText/C4 validation losses
+(reference docs/training-examples.md:160-162, :181-184). Without network
+access, two corpora give the same kind of evidence through the same
+Perceiver AR recipe (scripts/text/clm.py semantics):
+
+* ``MarkovByteSource`` — an order-2 Markov chain over a byte alphabet with a
+  seeded Dirichlet transition tensor. Its per-token conditional entropy is
+  COMPUTED ANALYTICALLY (stationary distribution of the pair chain x row
+  entropies), giving the one thing real corpora cannot: an exact loss target.
+  A correct model + trainer must drive validation CE to that floor; any gap is
+  model/optimizer error, not data noise.
+* ``python_source_corpus`` — the installed site-packages' own .py files
+  (deterministic sorted order, size-capped): real, messy, human-written text
+  available in-image for realistic loss curves.
+
+Batches follow the CLM trainer contract (training/trainer.py:123-153):
+``input_ids`` (B, L) and ``labels`` = next token at each position.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from perceiver_io_tpu.data.loader import DataLoader
+
+
+@dataclass
+class MarkovByteSource:
+    """Order-2 Markov chain with an analytically known entropy floor."""
+
+    vocab_size: int = 64
+    concentration: float = 0.05  # Dirichlet alpha: smaller = peakier rows = lower entropy
+    seed: int = 0
+
+    def transitions(self) -> np.ndarray:
+        """T[a, b, c] = P(next = c | prev = a, b), deterministic in seed."""
+        rng = np.random.default_rng(self.seed)
+        A = self.vocab_size
+        T = rng.dirichlet(np.full(A, self.concentration), size=(A, A)).astype(np.float64)
+        return T
+
+    def entropy_floor(self) -> float:
+        """Exact conditional entropy H(X_t | X_{t-2}, X_{t-1}) in nats/token:
+        the stationary pair distribution (power iteration on the (a,b)->(b,c)
+        chain) weighting each row's Shannon entropy. A model with >= 2 tokens
+        of context cannot do better; validation CE converging here is a
+        correctness proof for the whole training stack."""
+        T = self.transitions()
+        A = self.vocab_size
+        pi = np.full((A, A), 1.0 / (A * A))
+        for _ in range(200):
+            # pi'(b, c) = sum_a pi(a, b) T[a, b, c]
+            nxt = np.einsum("ab,abc->bc", pi, T)
+            if np.abs(nxt - pi).max() < 1e-14:
+                pi = nxt
+                break
+            pi = nxt
+        logT = np.log(T, out=np.zeros_like(T), where=T > 0)
+        row_h = -np.sum(T * logT, axis=-1)  # (A, A)
+        return float(np.sum(pi * row_h))
+
+    def sample(self, n_tokens: int, seed: Optional[int] = None) -> np.ndarray:
+        """Draw one corpus of ``n_tokens`` int32 ids (inverse-CDF sampling)."""
+        T = self.transitions()
+        cdf = np.cumsum(T, axis=-1)
+        rng = np.random.default_rng(self.seed + 1 if seed is None else seed)
+        out = np.empty(n_tokens, np.int32)
+        a, b = rng.integers(0, self.vocab_size, size=2)
+        u = rng.random(n_tokens)
+        for i in range(n_tokens):
+            c = int(np.searchsorted(cdf[a, b], u[i], side="right"))
+            c = min(c, self.vocab_size - 1)
+            out[i] = c
+            a, b = b, c
+        return out
+
+
+def python_source_corpus(max_bytes: int = 8_000_000, packages=("jax", "numpy", "flax", "optax")) -> np.ndarray:
+    """Byte corpus from the installed site-packages' .py files (deterministic
+    sorted traversal, capped at ``max_bytes``): real human-written text
+    available without network access. Returns uint8 ids (byte-level vocab)."""
+    import sysconfig
+
+    root = sysconfig.get_paths()["purelib"]
+    chunks, total = [], 0
+    for pkg in packages:
+        for path in sorted(glob.glob(os.path.join(root, pkg, "**", "*.py"), recursive=True)):
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            chunks.append(np.frombuffer(data, np.uint8))
+            total += len(data)
+            if total >= max_bytes:
+                break
+        if total >= max_bytes:
+            break
+    corpus = np.concatenate(chunks)[:max_bytes]
+    return corpus
+
+
+class _WindowDataset:
+    """Non-overlapping fixed-length windows with next-token labels."""
+
+    def __init__(self, ids: np.ndarray, seq_len: int):
+        n = (len(ids) - 1) // seq_len
+        self.x = ids[: n * seq_len].reshape(n, seq_len).astype(np.int32)
+        self.y = ids[1 : n * seq_len + 1].reshape(n, seq_len).astype(np.int32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return {"input_ids": self.x[idx], "labels": self.y[idx]}
+
+
+@dataclass
+class SyntheticTextDataModule:
+    """CLM data module over a Markov or python-source byte corpus."""
+
+    source: str = "markov"  # "markov" | "python_source"
+    seq_len: int = 512
+    batch_size: int = 16
+    n_train_tokens: int = 2_000_000
+    n_val_tokens: int = 100_000
+    vocab_size: int = 64  # markov only; python_source is byte-level (256)
+    concentration: float = 0.05
+    seed: int = 0
+    shuffle: bool = True
+
+    def __post_init__(self):
+        self.ds_train = None
+        self.ds_valid = None
+        self._rng = np.random.default_rng(self.seed)
+        self.entropy_floor: Optional[float] = None
+
+    @property
+    def effective_vocab_size(self) -> int:
+        return self.vocab_size if self.source == "markov" else 256
+
+    def prepare_data(self) -> None:
+        pass  # nothing to download
+
+    def setup(self) -> None:
+        if self.source == "markov":
+            src = MarkovByteSource(vocab_size=self.vocab_size, concentration=self.concentration, seed=self.seed)
+            self.entropy_floor = src.entropy_floor()
+            train_ids = src.sample(self.n_train_tokens, seed=self.seed + 1)
+            val_ids = src.sample(self.n_val_tokens, seed=self.seed + 2)
+        elif self.source == "python_source":
+            corpus = python_source_corpus(max_bytes=self.n_train_tokens + self.n_val_tokens)
+            train_ids = corpus[: self.n_train_tokens]
+            val_ids = corpus[self.n_train_tokens :]
+        else:
+            raise ValueError(f"unknown source {self.source!r}: expected markov | python_source")
+        self.ds_train = _WindowDataset(train_ids, self.seq_len)
+        self.ds_valid = _WindowDataset(val_ids, self.seq_len)
+
+    def _collate(self, examples):
+        return {
+            "input_ids": np.stack([e["input_ids"] for e in examples]),
+            "labels": np.stack([e["labels"] for e in examples]),
+        }
+
+    def train_dataloader(self) -> DataLoader:
+        loader_rng = np.random.default_rng(self._rng.integers(0, 2**63))
+        return DataLoader(self.ds_train, self.batch_size, collate_fn=self._collate, shuffle=self.shuffle, rng=loader_rng)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(self.ds_valid, self.batch_size, collate_fn=self._collate, shuffle=False, drop_last=False)

@@ -95,7 +95,7 @@ class MNISTDataModule:
     def setup(self) -> None:
         tr_images, tr_labels = self._load("train")
         va_images, va_labels = self._load("test")
-        tf_train = lambda im: mnist_transform(im, self.normalize, self.channels_last, self.random_crop, self._rng)
+        tf_train = lambda im: mnist_transform(im, self.normalize, self.channels_last, random_crop=self.random_crop, rng=self._rng)
         tf_valid = lambda im: mnist_transform(im, self.normalize, self.channels_last, None, center_crop=self.random_crop)
         self.ds_train = _MnistSplit(tr_images, tr_labels, tf_train)
         self.ds_valid = _MnistSplit(va_images, va_labels, tf_valid)

@@ -1,0 +1,284 @@
+"""Convergence-evidence runner: real learning curves per model family without
+network access (VERDICT round-1 item 4; reference quality targets in
+BASELINE.md / reference docs/training-examples.md:144-184).
+
+Tasks (each writes convergence/<task>.json with the full eval history):
+
+  digits_glyphs    the MNIST recipe (exact scripts/vision/image_classifier.py
+                   architecture, 907K params) on generated 28x28 digits;
+                   target: val_acc >= 0.98 (the reference's MNIST bar).
+  digits_sklearn   a smaller Perceiver IO on the bundled real scikit-learn
+                   digits (1,797 8x8 scans); target: val_acc >= 0.98.
+  clm_markov       Perceiver AR byte CLM on an order-2 Markov corpus whose
+                   conditional entropy is computed analytically — the one
+                   corpus with an EXACT loss target; met when val CE is within
+                   0.05 nats of the floor.
+  clm_pysrc        Perceiver AR byte CLM on the installed site-packages'
+                   python source (real text, no analytic floor): the curve +
+                   final bits/byte are recorded.
+
+Usage:
+  python -m perceiver_io_tpu.scripts.convergence --task digits_glyphs
+  python -m perceiver_io_tpu.scripts.convergence --task all --out convergence
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fit(model, eval_model, data, steps, lr, make_train_step, make_eval_step,
+         monitor, monitor_mode, init_fn, warmup_cap=500):
+    import optax
+
+    from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
+    from perceiver_io_tpu.training.trainer import TrainState
+
+    params = jax.jit(init_fn)()
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    tx = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.adamw(optax.warmup_cosine_decay_schedule(0.0, lr, min(warmup_cap, steps // 4), steps)))
+    state = TrainState.create(params, tx)
+    eval_every = max(steps // 12, 1)
+    trainer = Trainer(TrainerConfig(
+        max_steps=steps, eval_every=eval_every, log_every=eval_every,
+        monitor=monitor, monitor_mode=monitor_mode,
+    ))
+    trainer.fit(state, make_train_step(model, tx), data.train_dataloader,
+                eval_step=make_eval_step(eval_model), eval_loader_fn=data.val_dataloader)
+    return trainer.history, n_params
+
+
+def run_digits(source: str, steps: int, task_name: str = ""):
+    from perceiver_io_tpu.data.vision.synthetic import SyntheticDigitsDataModule
+    from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
+    from perceiver_io_tpu.models.vision.image_classifier import (
+        ImageClassifier,
+        ImageClassifierConfig,
+        ImageEncoderConfig,
+    )
+    from perceiver_io_tpu.training.trainer import make_classifier_eval_step, make_classifier_train_step
+
+    if source == "glyphs":
+        data = SyntheticDigitsDataModule(source="glyphs", n_train=20_000, n_val=2_000, batch_size=128)
+        # the exact MNIST recipe architecture (scripts/vision/image_classifier.py)
+        enc_kw = dict(num_frequency_bands=32, num_cross_attention_layers=2, num_cross_attention_heads=1,
+                      num_self_attention_blocks=3, num_self_attention_layers_per_block=3,
+                      num_self_attention_heads=8, first_cross_attention_layer_shared=False,
+                      first_self_attention_block_shared=False, dropout=0.1, init_scale=0.1)
+        num_latents, num_latent_channels = 32, 128
+    else:
+        data = SyntheticDigitsDataModule(source="sklearn_digits", batch_size=64)
+        enc_kw = dict(num_frequency_bands=12, num_cross_attention_layers=1, num_cross_attention_heads=1,
+                      num_self_attention_blocks=2, num_self_attention_layers_per_block=2,
+                      num_self_attention_heads=4, dropout=0.1, init_scale=0.1)
+        num_latents, num_latent_channels = 16, 64
+    data.setup()
+
+    encoder = ImageEncoderConfig(image_shape=data.image_shape, **enc_kw)
+    decoder = ClassificationDecoderConfig(num_classes=10, num_output_query_channels=128,
+                                          num_cross_attention_heads=1, dropout=0.1, init_scale=0.1)
+    config = ImageClassifierConfig(encoder=encoder, decoder=decoder,
+                                   num_latents=num_latents, num_latent_channels=num_latent_channels)
+    model = ImageClassifier(config=config, deterministic=False)
+    eval_model = ImageClassifier(config=config, deterministic=True)
+
+    sample = jnp.zeros((2, *data.image_shape))
+    rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)}
+    history, n_params = _fit(
+        model, eval_model, data, steps, lr=1e-3,
+        make_train_step=make_classifier_train_step, make_eval_step=make_classifier_eval_step,
+        monitor="acc", monitor_mode="max", init_fn=lambda: model.init(rngs, sample),
+    )
+    accs = [h["val_acc"] for h in history if "val_acc" in h]
+    return {
+        "task": task_name or f"digits_{source}",
+        "model_params": n_params,
+        "target": {"metric": "val_acc", "value": 0.98,
+                   "provenance": "reference MNIST bar, docs/training-examples.md:144-150 (0.98160)"},
+        "achieved": max(accs) if accs else None,
+        "met": bool(accs and max(accs) >= 0.98),
+        "history": history,
+    }
+
+
+def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
+    from perceiver_io_tpu.data.text.synthetic import SyntheticTextDataModule
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+    from perceiver_io_tpu.training.trainer import make_causal_lm_eval_step, make_causal_lm_train_step
+
+    # The corpus's entropy floor is a property of the DATA, so the loss target
+    # stays exact regardless of model size — a cpu profile keeps single-core
+    # runs feasible (this image exposes one core when the TPU tunnel is down).
+    if not profile:
+        profile = "tpu" if jax.default_backend() == "tpu" else "cpu"
+    small = profile == "cpu"
+    seq = 256 if small else 512
+    if source == "markov":
+        data = SyntheticTextDataModule(source="markov", seq_len=seq, batch_size=16,
+                                       n_train_tokens=1_000_000 if small else 2_000_000,
+                                       n_val_tokens=50_000 if small else 100_000,
+                                       vocab_size=32 if small else 64)
+    else:
+        data = SyntheticTextDataModule(source="python_source", seq_len=seq if small else 1024,
+                                       batch_size=8,
+                                       n_train_tokens=2_000_000 if small else 8_000_000,
+                                       n_val_tokens=200_000 if small else 400_000)
+    data.setup()
+
+    config = CausalSequenceModelConfig(
+        vocab_size=data.effective_vocab_size, max_seq_len=data.seq_len,
+        max_latents=data.seq_len // 2, num_channels=128 if small else 256,
+        num_heads=4 if small else 8,
+        num_self_attention_layers=2 if small else 4, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config, deterministic=False)
+    eval_model = CausalSequenceModel(config=config, deterministic=True)
+
+    x = jnp.zeros((2, data.seq_len), jnp.int32)
+    rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)}
+    # lr 2e-3 measured necessary to reach the markov floor: at 3e-4 the model
+    # plateaus near the marginal entropy (bigram structure barely forms)
+    history, n_params = _fit(
+        model, eval_model, data, steps, lr=2e-3,
+        make_train_step=lambda m, tx: make_causal_lm_train_step(m, tx, max_latents=config.max_latents),
+        make_eval_step=lambda m: make_causal_lm_eval_step(m, max_latents=config.max_latents),
+        monitor="loss", monitor_mode="min", warmup_cap=150,
+        init_fn=lambda: model.init(rngs, x, prefix_len=data.seq_len - config.max_latents),
+    )
+
+    losses = [h["val_loss"] for h in history if "val_loss" in h]
+    achieved = min(losses) if losses else None
+    out = {
+        "task": task_name or f"clm_{source}",
+        "model_params": n_params,
+        "achieved_val_ce_nats": achieved,
+        "history": history,
+    }
+    out["profile"] = profile
+    if source == "markov":
+        floor = float(data.entropy_floor)
+        out["target"] = {"metric": "val_loss", "value": floor, "tolerance_nats": 0.05,
+                         "provenance": "analytic conditional entropy of the order-2 Markov corpus"}
+        out["met"] = bool(achieved is not None and achieved <= floor + 0.05)
+        out["entropy_floor_nats"] = floor
+        out["gap_nats"] = None if achieved is None else achieved - floor
+    else:
+        out["target"] = {"metric": "val_loss", "value": None,
+                         "provenance": "no analytic floor for real text; curve recorded"}
+        out["bits_per_byte"] = None if achieved is None else achieved / float(np.log(2.0))
+        out["met"] = achieved is not None
+    return out
+
+
+TASKS = {
+    "digits_glyphs": lambda steps: run_digits("glyphs", steps or 3000, "digits_glyphs"),
+    "digits_sklearn": lambda steps: run_digits("sklearn_digits", steps or 2000, "digits_sklearn"),
+    "clm_markov": lambda steps: run_clm("markov", steps or 2000, "clm_markov"),
+    "clm_pysrc": lambda steps: run_clm("python_source", steps or 2000, "clm_pysrc"),
+}
+
+
+def _spark(values, width=44):
+    """ASCII curve: min..max scaled to 8 glyph levels."""
+    if not values:
+        return ""
+    glyphs = "▁▂▃▄▅▆▇█"
+    if len(values) > width:
+        idx = np.linspace(0, len(values) - 1, width).round().astype(int)
+        values = [values[i] for i in idx]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(glyphs[int((v - lo) / span * 7)] for v in values)
+
+
+def render(out_dir: str, md_path: str = "CONVERGENCE.md") -> None:
+    """Regenerate CONVERGENCE.md from the recorded convergence/<task>.json files."""
+    sections = []
+    for name in TASKS:
+        path = os.path.join(out_dir, f"{name}.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            r = json.load(f)
+        hist = r.get("history", [])
+        metric = r["target"]["metric"]  # producers always write val_acc / val_loss
+        curve = [h[metric] for h in hist if metric in h]
+        lines = [f"## {r['task']}", ""]
+        lines.append(f"- model params: {r['model_params']:,}" + (f" (profile: {r['profile']})" if r.get("profile") else ""))
+        tgt = r["target"]
+        if tgt["value"] is not None:
+            lines.append(f"- target: {tgt['metric']} {'>=' if 'acc' in tgt['metric'] else '<='} {tgt['value']:.5g}"
+                         + (f" (+{tgt['tolerance_nats']} nats tolerance)" if "tolerance_nats" in tgt else "")
+                         + f" — {tgt['provenance']}")
+        else:
+            lines.append(f"- target: none ({tgt['provenance']})")
+        ach = r.get("achieved", r.get("achieved_val_ce_nats"))
+        ach_s = "n/a (no eval points recorded)" if ach is None else f"{ach:.5g}"
+        lines.append(f"- achieved: {ach_s} — **{'MET' if r.get('met') else 'NOT MET'}**")
+        if r.get("entropy_floor_nats") is not None:
+            lines.append(f"- analytic floor: {r['entropy_floor_nats']:.5g} nats; gap: {r['gap_nats']:.4g} nats")
+        if r.get("bits_per_byte") is not None:
+            lines.append(f"- bits/byte: {r['bits_per_byte']:.4g}")
+        if curve:
+            lines.append(f"- eval curve ({len(curve)} points, first {curve[0]:.4g} → best "
+                         f"{(max if 'acc' in metric else min)(curve):.4g}): `{_spark(curve)}`")
+        sections.append("\n".join(lines))
+
+    doc = [
+        "# Convergence evidence",
+        "",
+        "Real learning curves per model family, trained in-image with zero egress",
+        "(VERDICT round-1 item 4). Data sources and the analytic-loss-target",
+        "methodology live in `perceiver_io_tpu/data/{vision,text}/synthetic.py`;",
+        "rerun any curve with `python -m perceiver_io_tpu.scripts.convergence",
+        "--task <name>` and regenerate this file with `--render`.",
+        "",
+        "The `clm_markov` run is the strongest correctness statement: its corpus",
+        "has an analytically computed conditional entropy, so the validation CE",
+        "target is exact — converging to it proves model, loss, optimizer, data",
+        "pipeline and eval loop end-to-end with no dataset noise excuse.",
+        "",
+        *sections,
+        "",
+    ]
+    with open(md_path, "w") as f:
+        f.write("\n".join(doc))
+    print(f"wrote {md_path}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--task", default="all", choices=[*TASKS, "all"])
+    ap.add_argument("--steps", type=int, default=0, help="0 = per-task default")
+    ap.add_argument("--out", default="convergence")
+    ap.add_argument("--render", action="store_true", help="regenerate CONVERGENCE.md from recorded results")
+    args = ap.parse_args(argv)
+
+    # scratch out dirs keep their rendered markdown beside them; only the
+    # default artifact dir regenerates the repo-root CONVERGENCE.md
+    md_path = "CONVERGENCE.md" if args.out == "convergence" else os.path.join(args.out, "CONVERGENCE.md")
+    if args.render:
+        render(args.out, md_path)
+        return
+
+    os.makedirs(args.out, exist_ok=True)
+    names = list(TASKS) if args.task == "all" else [args.task]
+    for name in names:
+        result = TASKS[name](args.steps)
+        path = os.path.join(args.out, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps({k: v for k, v in result.items() if k != "history"}))
+        render(args.out, md_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Optional-dependency code paths (cv2 video IO, pretty_midi MIDI IO,
+fluidsynth WAV render) exercised against faked modules: the deps are absent
+from this image, but the logic around them — frame iteration, BGR/RGB
+conversion discipline, MIDI roundtrips, subprocess command construction —
+is real code that must not rot unverified (round-1 VERDICT weak item 7)."""
+
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------- fake cv2
+
+
+class _FakeCapture:
+    def __init__(self, frames):
+        self._frames = list(frames)
+        self._i = 0
+        self.released = False
+
+    def isOpened(self):
+        return bool(self._frames)
+
+    def read(self):
+        if self._i < len(self._frames):
+            f = self._frames[self._i]
+            self._i += 1
+            return True, f
+        return False, None
+
+    def release(self):
+        self.released = True
+
+
+class _FakeWriter:
+    def __init__(self, path, fourcc, fps, size):
+        self.path, self.fourcc, self.fps, self.size = path, fourcc, fps, size
+        self.frames = []
+        self.released = False
+
+    def isOpened(self):
+        return True
+
+    def write(self, frame):
+        self.frames.append(frame.copy())
+
+    def release(self):
+        self.released = True
+
+
+def _fake_cv2(frames):
+    cv2 = types.ModuleType("cv2")
+    cv2.COLOR_BGR2RGB = 1
+    cv2.COLOR_RGB2BGR = 2
+    cv2.cvtColor = lambda frame, code: frame[..., ::-1]  # channel reversal both ways
+    cv2.VideoCapture = lambda path: _FakeCapture(frames)
+    cv2.VideoWriter = _FakeWriter
+    cv2.VideoWriter_fourcc = lambda *chars: "".join(chars)
+    cv2._writers = []
+
+    def _writer(path, fourcc, fps, size):
+        w = _FakeWriter(path, fourcc, fps, size)
+        cv2._writers.append(w)
+        return w
+
+    cv2.VideoWriter = _writer
+    return cv2
+
+
+def test_read_video_frames_and_pairs(monkeypatch, tmp_path):
+    from perceiver_io_tpu.data.vision import video_utils
+
+    bgr = [np.full((4, 6, 3), i, np.uint8) for i in range(5)]
+    monkeypatch.setitem(sys.modules, "cv2", _fake_cv2(bgr))
+    video = tmp_path / "clip.mp4"
+    video.write_bytes(b"")
+
+    frames = list(video_utils.read_video_frames(video))
+    assert len(frames) == 5
+    # BGR -> RGB conversion applied
+    np.testing.assert_array_equal(frames[0], bgr[0][..., ::-1])
+
+    pairs = list(video_utils.read_video_frame_pairs(video))
+    assert len(pairs) == 4
+    np.testing.assert_array_equal(pairs[0][1], frames[1])
+
+
+def test_read_video_errors(monkeypatch, tmp_path):
+    from perceiver_io_tpu.data.vision import video_utils
+
+    monkeypatch.setitem(sys.modules, "cv2", _fake_cv2([]))
+    with pytest.raises(ValueError, match="does not exist"):
+        video_utils.read_video_frames(tmp_path / "missing.mp4")
+    empty = tmp_path / "empty.mp4"
+    empty.write_bytes(b"")
+    with pytest.raises(ValueError, match="Could not open"):
+        video_utils.read_video_frames(empty)  # fake capture with no frames reports closed
+
+
+def test_write_video(monkeypatch, tmp_path):
+    from perceiver_io_tpu.data.vision import video_utils
+
+    cv2 = _fake_cv2([])
+    monkeypatch.setitem(sys.modules, "cv2", cv2)
+    rgb = [np.full((4, 6, 3), i, np.uint8) for i in range(3)]
+    video_utils.write_video(tmp_path / "out.mp4", rgb, fps=24)
+    (writer,) = cv2._writers
+    assert writer.fps == 24 and writer.size == (6, 4) and writer.released
+    # RGB -> BGR on the way out
+    np.testing.assert_array_equal(writer.frames[1], rgb[1][..., ::-1])
+    with pytest.raises(ValueError, match="mp4"):
+        video_utils.write_video(tmp_path / "out.avi", rgb)
+    with pytest.raises(ValueError, match="no frames"):
+        video_utils.write_video(tmp_path / "o.mp4", [])
+
+
+# ---------------------------------------------------------- fake pretty_midi
+
+
+def _fake_pretty_midi():
+    pm = types.ModuleType("pretty_midi")
+
+    class Note:
+        def __init__(self, velocity, pitch, start, end):
+            self.velocity, self.pitch, self.start, self.end = velocity, pitch, start, end
+
+    class ControlChange:
+        def __init__(self, number, value, time):
+            self.number, self.value, self.time = number, value, time
+
+    class Instrument:
+        def __init__(self, program, is_drum=False, name=""):
+            self.program, self.is_drum, self.name = program, is_drum, name
+            self.notes = []
+            self.control_changes = []
+
+    class PrettyMIDI:
+        preset_notes = []  # set by tests: notes used when "loading" a path
+
+        def __init__(self, path=None):
+            self.instruments = []
+            self.written_to = None
+            if path is not None:
+                inst = Instrument(0)
+                inst.notes = list(self.preset_notes)
+                self.instruments.append(inst)
+
+        def write(self, path):
+            self.written_to = path
+
+    pm.Note, pm.ControlChange, pm.Instrument, pm.PrettyMIDI = Note, ControlChange, Instrument, PrettyMIDI
+    return pm
+
+
+def test_encode_decode_midi_roundtrip(monkeypatch):
+    pm = _fake_pretty_midi()
+    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
+    from perceiver_io_tpu.data.audio import midi_processor as mp
+
+    midi = pm.PrettyMIDI()
+    inst = pm.Instrument(0)
+    inst.notes = [pm.Note(64, 60, 0.0, 0.5), pm.Note(80, 72, 0.25, 1.0)]
+    midi.instruments.append(inst)
+
+    tokens = mp.encode_midi(midi)
+    assert tokens and all(isinstance(t, int) for t in tokens)
+
+    out = mp.decode_midi(tokens, file_path="/tmp/x.mid")
+    assert out.written_to == "/tmp/x.mid"
+    notes = out.instruments[0].notes
+    assert [(n.pitch, n.start) for n in notes] == [(60, 0.0), (72, 0.25)]
+    # velocity is quantized to steps of 4 by the event codec
+    assert all(abs(a.velocity - b.velocity) <= 4 for a, b in zip(notes, inst.notes))
+
+
+def test_encode_midi_file_skips_unreadable(monkeypatch, capsys):
+    pm = _fake_pretty_midi()
+
+    def boom(path):
+        raise OSError("corrupt file")
+
+    pm.PrettyMIDI = boom
+    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
+    from perceiver_io_tpu.data.audio import midi_processor as mp
+
+    assert mp.encode_midi_file("/nope/x.mid") is None
+    assert "Error encoding midi file" in capsys.readouterr().out
+
+
+# ------------------------------------------------- fluidsynth render + pipeline
+
+
+def test_render_wav_command(monkeypatch):
+    from perceiver_io_tpu.pipelines import SymbolicAudioPipeline
+
+    calls = []
+
+    def fake_run(cmd, check, capture_output):
+        calls.append(cmd)
+        return subprocess.CompletedProcess(cmd, 0)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+
+    class _Midi:
+        def write(self, path):
+            self.path = path
+
+    midi = _Midi()
+    SymbolicAudioPipeline.render_wav(midi, "/tmp/out.wav")
+    (cmd,) = calls
+    assert cmd[0] == "fluidsynth" and "-F" in cmd and "/tmp/out.wav" in cmd
+    assert cmd[-1] == midi.path  # temp .mid path goes last
+
+    calls.clear()
+    SymbolicAudioPipeline.render_wav(midi, "/tmp/out.wav", soundfont_path="/sf/font.sf2")
+    (cmd,) = calls
+    assert cmd[1] == "/sf/font.sf2"  # soundfont inserted before flags
+
+
+def test_symbolic_audio_pipeline_midi_path_input(monkeypatch, tmp_path):
+    """End-to-end pipeline with a .mid path prompt: fake pretty_midi load,
+    real codec, real (tiny) model generate, fake pretty_midi output."""
+    import jax
+    import jax.numpy as jnp
+
+    pm = _fake_pretty_midi()
+    pm.PrettyMIDI.preset_notes = [pm.Note(64, 60, 0.0, 0.3), pm.Note(72, 62, 0.3, 0.6)]
+    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
+
+    from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+    from perceiver_io_tpu.pipelines import SymbolicAudioPipeline
+
+    cfg = SymbolicAudioModelConfig(max_seq_len=64, max_latents=16, num_channels=32,
+                                   num_heads=2, num_self_attention_layers=1)
+    model = SymbolicAudioModel(config=cfg)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, 24), jnp.int32)
+    params = model.init(rng, x, prefix_len=8)
+
+    mid_path = tmp_path / "prompt.mid"
+    mid_path.write_bytes(b"")
+    pipe = SymbolicAudioPipeline(model=model, params=params)
+    out = pipe(str(mid_path), num_latents=4, max_new_tokens=4, output_midi_path=str(tmp_path / "gen.mid"))
+    assert out.written_to == str(tmp_path / "gen.mid")
