@@ -156,7 +156,16 @@ class SyntheticTextDataModule:
             train_ids = src.sample(self.n_train_tokens, seed=self.seed + 1)
             val_ids = src.sample(self.n_val_tokens, seed=self.seed + 2)
         elif self.source == "python_source":
-            corpus = python_source_corpus(max_bytes=self.n_train_tokens + self.n_val_tokens)
+            want = self.n_train_tokens + self.n_val_tokens
+            corpus = python_source_corpus(max_bytes=want)
+            if len(corpus) < want:
+                # a silent shortfall would leave an empty split and an endless
+                # epoch loop; fail with the actual numbers instead
+                raise ValueError(
+                    f"python_source corpus holds only {len(corpus)} bytes; "
+                    f"requested {want} (n_train_tokens + n_val_tokens) — lower the request "
+                    "or add packages to python_source_corpus"
+                )
             train_ids = corpus[: self.n_train_tokens]
             val_ids = corpus[self.n_train_tokens :]
         else:

@@ -101,6 +101,10 @@ class PerceiverARConfig:
     # unroll, measured +2.9 MFU points on the 455M flagship where the scan's
     # carry writes cost real bandwidth (NOTES.md)
     scan_unroll: int = 1
+    # single-GEMM q/k/v projections: kernels concatenated at APPLY time, so the
+    # param tree and checkpoints are unchanged — a pure execution knob for
+    # on-chip ablation (NOTES.md §1)
+    fused_qkv: bool = False
     # mesh axis name for sequence-parallel ring attention over the prefix/latent
     # sequences (long-context training beyond one chip's memory); None = off
     sequence_parallel_axis: Optional[str] = None

@@ -111,6 +111,7 @@ class CrossAttention(nn.Module):
     causal_attention: bool = False
     dropout: float = 0.0
     qkv_bias: bool = True
+    fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
     init_scale: float = 0.02
     seq_axis: Optional[str] = None
@@ -132,6 +133,7 @@ class CrossAttention(nn.Module):
             causal_attention=self.causal_attention,
             dropout=self.dropout,
             qkv_bias=self.qkv_bias,
+            fused_qkv=self.fused_qkv,
             out_bias=self.out_bias,
             kernel_init_scale=self.init_scale,
             seq_axis=self.seq_axis,
@@ -171,6 +173,7 @@ class SelfAttention(nn.Module):
     causal_attention: bool = False
     dropout: float = 0.0
     qkv_bias: bool = True
+    fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
     init_scale: float = 0.02
     seq_axis: Optional[str] = None
@@ -190,6 +193,7 @@ class SelfAttention(nn.Module):
             causal_attention=self.causal_attention,
             dropout=self.dropout,
             qkv_bias=self.qkv_bias,
+            fused_qkv=self.fused_qkv,
             out_bias=self.out_bias,
             kernel_init_scale=self.init_scale,
             seq_axis=self.seq_axis,
@@ -224,6 +228,7 @@ class CrossAttentionLayer(nn.Module):
     residual_dropout: float = 0.0
     attention_residual: bool = True
     qkv_bias: bool = True
+    fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
@@ -243,6 +248,7 @@ class CrossAttentionLayer(nn.Module):
             causal_attention=self.causal_attention,
             dropout=self.dropout,
             qkv_bias=self.qkv_bias,
+            fused_qkv=self.fused_qkv,
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             seq_axis=self.seq_axis,
@@ -292,6 +298,7 @@ class SelfAttentionLayer(nn.Module):
     dropout: float = 0.0
     residual_dropout: float = 0.0
     qkv_bias: bool = True
+    fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
@@ -310,6 +317,7 @@ class SelfAttentionLayer(nn.Module):
             causal_attention=self.causal_attention,
             dropout=self.dropout,
             qkv_bias=self.qkv_bias,
+            fused_qkv=self.fused_qkv,
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             seq_axis=self.seq_axis,
@@ -368,6 +376,7 @@ class SelfAttentionBlock(nn.Module):
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name, e.g. "dots_with_no_batch_dims_saveable"
     qkv_bias: bool = True
+    fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
     mlp_bias: bool = True
     init_scale: float = 0.02
@@ -432,6 +441,7 @@ class SelfAttentionBlock(nn.Module):
             dropout=self.dropout,
             residual_dropout=self.residual_dropout,
             qkv_bias=self.qkv_bias,
+            fused_qkv=self.fused_qkv,
             out_bias=self.out_bias,
             mlp_bias=self.mlp_bias,
             init_scale=self.init_scale,

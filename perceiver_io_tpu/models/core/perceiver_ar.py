@@ -99,6 +99,7 @@ class PerceiverAR(nn.Module):
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None
     scan_unroll: int = 1
+    fused_qkv: bool = False  # single-GEMM q/k/v projections (execution knob; NOTES.md)
     init_scale: float = 0.02
     sequence_parallel_axis: Optional[str] = None  # mesh axis for ring attention (long context)
     deterministic: bool = True
@@ -117,6 +118,7 @@ class PerceiverAR(nn.Module):
             dropout=self.post_attention_dropout,
             residual_dropout=self.residual_dropout,
             qkv_bias=False,
+            fused_qkv=self.fused_qkv,
             out_bias=True,
             mlp_bias=False,
             init_scale=self.init_scale,
@@ -139,6 +141,7 @@ class PerceiverAR(nn.Module):
             remat_policy=self.remat_policy,
             scan_unroll=self.scan_unroll,
             qkv_bias=False,
+            fused_qkv=self.fused_qkv,
             out_bias=False,
             mlp_bias=False,
             init_scale=self.init_scale,
@@ -372,6 +375,7 @@ class CausalSequenceModel(nn.Module):
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
             scan_unroll=cfg.scan_unroll,
+            fused_qkv=cfg.fused_qkv,
             init_scale=cfg.init_scale,
             deterministic=self.deterministic,
             dtype=self.dtype,

@@ -29,16 +29,34 @@ import jax.numpy as jnp
 _BLOCK = 512
 
 
-def decode_kernel_supported(n_q: int, capacity: int, num_qk: int, num_v: int, num_heads: int = 1) -> bool:
-    """Short-query cached decode on one TPU chip with symmetric qk/v widths and
-    a block-tileable cache. ``n_q > 1`` covers multi-query decode (speculative /
+def decode_kernel_supported(
+    n_q: int, capacity: int, num_qk: int, num_v: int, num_heads: int = 1,
+    batch_size: Optional[int] = None,
+) -> bool:
+    """Short-query cached decode on TPU with symmetric qk/v widths and a
+    block-tileable cache. ``n_q > 1`` covers multi-query decode (speculative /
     chunked verification); each query keeps its flash stats in its own scratch
-    row, so n_q is bounded by the 8-sublane scratch tile.
+    row, so n_q is bounded by the 8-sublane scratch tile. Multi-chip: supported
+    when the ambient mesh shards only batch axes and the batch divides evenly
+    (the kernel then runs per-device inside shard_map — no collectives).
     Kill-switch: PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL."""
     if os.environ.get("PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL", "0").lower() not in ("0", "false", ""):
         return False
-    if jax.default_backend() != "tpu" or jax.device_count() > 1:
+    if jax.default_backend() != "tpu":
         return False
+    if jax.device_count() > 1:
+        from perceiver_io_tpu.ops.flash import _mesh_plan
+
+        plan = _mesh_plan()
+        if plan is None:
+            return False
+        _, head_axis, b_shards, _ = plan
+        if head_axis is not None:
+            # heads live packed inside the (cap, h*d) cache layout; a sharded
+            # head axis cannot be mapped onto this kernel
+            return False
+        if batch_size is None or (b_shards > 1 and batch_size % b_shards != 0):
+            return False
     return (
         1 <= n_q <= 8  # one (8, 128) scratch sublane of running stats per query
         and num_qk == num_v
@@ -155,6 +173,47 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
             l_x = jax.lax.dot_general(1.0 / l, exp_ref[:], contract, preferred_element_type=jnp.float32)
             rows.append(acc_ref[qi : qi + 1, :] * l_x)
         o_ref[0] = (rows[0] if n_q == 1 else jnp.concatenate(rows, axis=0)).astype(o_ref.dtype)
+
+
+def fused_decode_attention_auto(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    rope_k: jax.Array,
+    q_pos: jax.Array,
+    pad_slots: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Mesh-aware dispatch: under an ambient mesh that shards batch axes, the
+    kernel runs per-device inside shard_map (batch-sharded caches stay put, no
+    collectives); otherwise falls through to the plain pallas call. Gating —
+    batch divisibility, no sharded head axis — is decode_kernel_supported's job."""
+    from perceiver_io_tpu.ops.flash import _mesh_plan
+
+    plan = _mesh_plan() if jax.device_count() > 1 else None
+    if plan is None or not plan[0]:
+        return fused_decode_attention(q, k_cache, v_cache, rope_k, q_pos, pad_slots, interpret=interpret)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    b = q.shape[0]
+    baxes = plan[0]
+    q_pos_b = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    fn = shard_map(
+        lambda q, k, v, a, pos, pad: fused_decode_attention(q, k, v, a, pos, pad, interpret=interpret),
+        in_specs=(
+            P(baxes, None, None, None),
+            P(baxes, None, None),
+            P(baxes, None, None),
+            P(baxes, None, None),
+            P(baxes),
+            P(baxes, None),
+        ),
+        out_specs=P(baxes, None, None, None),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, rope_k, q_pos_b, pad_slots)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -117,10 +117,10 @@ def test_cached_multi_token_attention_with_kernel_matches_plain(monkeypatch):
 
     def run(force_kernel):
         if force_kernel:
-            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a: 1 <= n_q <= 8)
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a, **kw: 1 <= n_q <= 8)
             monkeypatch.setattr(dk, "fused_decode_attention", lambda *a, **kw: real_fused(*a, interpret=True))
         else:
-            monkeypatch.setattr(dk, "decode_kernel_supported", lambda *a: False)
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda *a, **kw: False)
         cache = KVCache.create(b, 16, ch, ch)
         out0, cache = mha.apply(params, x_ctx, x_ctx, kv_cache=cache)
         out1, cache = mha.apply(params, x_new, x_new, kv_cache=cache)
@@ -164,7 +164,7 @@ def test_full_model_decode_with_kernel_matches_plain(monkeypatch):
 
     def run_decode(force_kernel):
         if force_kernel:
-            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a: n_q == 1)
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a, **kw: n_q == 1)
             monkeypatch.setattr(
                 dk, "fused_decode_attention",
                 lambda *a, **kw: real_fused(*a, interpret=True),
@@ -181,3 +181,45 @@ def test_full_model_decode_with_kernel_matches_plain(monkeypatch):
     plain = run_decode(False)
     fused = run_decode(True)
     np.testing.assert_allclose(fused, plain, atol=2e-5)
+
+
+def test_fused_decode_attention_auto_sharded_batch():
+    """Mesh-aware dispatch: under a batch-sharded ambient mesh the kernel runs
+    per-device inside shard_map (interpret mode on the 8-virtual-device CPU
+    backend) and must match the single-device reference."""
+    from perceiver_io_tpu.parallel.mesh import make_mesh
+
+    b, h, d, cap, r = 8, 2, 32, 256, 16
+    rng = lambda i: jax.random.PRNGKey(i)
+    q = jax.random.normal(rng(0), (b, h, 1, d)) * 0.3
+    k = jax.random.normal(rng(1), (b, cap, h * d)) * 0.3
+    v = jax.random.normal(rng(2), (b, cap, h * d)) * 0.3
+    ang = jnp.repeat(jax.random.normal(rng(3), (b, cap, r // 2)) * 0.5, 2, axis=-1)
+    pad = jnp.zeros((b, cap), bool)
+    q_pos = jnp.asarray(200)
+
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    with jax.sharding.set_mesh(mesh):
+        out = jax.jit(lambda *a: dk.fused_decode_attention_auto(*a, interpret=True))(
+            q, k, v, ang, q_pos, pad
+        )
+    ref = xla_reference(q, k, v, ang, jnp.full((b,), 200), pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_decode_kernel_supported_multichip_gates(monkeypatch):
+    """Multi-chip gating: batch-mappable meshes pass only with a divisible
+    batch; sharded head/seq axes are rejected."""
+    from perceiver_io_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(dk.jax, "default_backend", lambda: "tpu")
+    assert jax.device_count() > 1  # conftest forces 8 virtual CPU devices
+
+    with jax.sharding.set_mesh(make_mesh({"data": 4}, devices=jax.devices()[:4])):
+        assert dk.decode_kernel_supported(1, 4096, 512, 512, 8, batch_size=8)
+        assert not dk.decode_kernel_supported(1, 4096, 512, 512, 8, batch_size=6)  # 6 % 4 != 0
+        assert not dk.decode_kernel_supported(1, 4096, 512, 512, 8)  # unknown batch
+    with jax.sharding.set_mesh(make_mesh({"tensor": 4}, devices=jax.devices()[:4])):
+        assert not dk.decode_kernel_supported(1, 4096, 512, 512, 8, batch_size=8)  # head axis
+    with jax.sharding.set_mesh(make_mesh({"seq": 4}, devices=jax.devices()[:4])):
+        assert not dk.decode_kernel_supported(1, 4096, 512, 512, 8, batch_size=8)  # unmappable
