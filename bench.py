@@ -98,6 +98,10 @@ def bench_clm_30m():
     config = CausalSequenceModelConfig(
         vocab_size=262, max_seq_len=4096, max_latents=512, num_channels=512,
         num_heads=8, num_self_attention_layers=8, cross_attention_dropout=0.5,
+        # single-GEMM qkv: +15% on this config's small per-layer GEMMs (scripts/
+        # ablate.py on v5e: 142.2k -> 163.8k tok/s; no effect on the 455M config
+        # whose GEMMs already saturate the MXU — see NOTES.md ablation table)
+        fused_qkv=True,
     )
     return _bench_clm_config(config, batch_size=8, n_steps=10,
                              metric="perceiver_ar_clm_30m_train_tokens_per_sec_per_chip")

@@ -1,0 +1,76 @@
+"""On-chip execution-knob ablations for the CLM bench configs.
+
+Runs the same timed jitted train step as ``bench.py`` over a list of config
+variants and prints one JSON line per variant, e.g.::
+
+    python scripts/ablate.py --config 30m \
+        --variant base \
+        --variant fused:fused_qkv=True \
+        --variant unroll:scan_unroll=8
+
+Each ``--variant`` is ``name[:field=value,field=value...]`` where fields are
+``CausalSequenceModelConfig`` fields (values parsed with ``ast.literal_eval``,
+bare words fall back to strings). The baseline knobs match bench.py's tasks so
+numbers are directly comparable to BENCH_r* records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root (bench.py)
+
+from bench import _bench_clm_config  # noqa: E402
+
+
+def _parse_variant(spec):
+    name, _, rest = spec.partition(":")
+    overrides = {}
+    if rest:
+        for pair in rest.split(","):
+            key, _, raw = pair.partition("=")
+            if not _ or not key:
+                sys.exit(f"bad --variant field {pair!r}: expected field=value")
+            try:
+                overrides[key] = ast.literal_eval(raw)
+            except (ValueError, SyntaxError):
+                overrides[key] = raw  # bare string, e.g. a remat policy name
+    return name, overrides
+
+
+def main():
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig, flagship_455m_config
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", choices=("30m", "455m"), default="30m")
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--variant", action="append", default=[], metavar="name[:k=v,...]")
+    args = parser.parse_args()
+
+    if args.config == "455m":
+        base, batch, steps = flagship_455m_config(), 16, 5
+    else:
+        base = CausalSequenceModelConfig(
+            vocab_size=262, max_seq_len=4096, max_latents=512, num_channels=512,
+            num_heads=8, num_self_attention_layers=8, cross_attention_dropout=0.5,
+        )
+        batch, steps = 8, 10
+    batch = args.batch_size or batch
+    steps = args.steps or steps
+
+    for spec in args.variant or ["base"]:
+        name, overrides = _parse_variant(spec)
+        config = dataclasses.replace(base, **overrides)
+        result = _bench_clm_config(config, batch_size=batch, n_steps=steps,
+                                   metric=f"ablate_{args.config}_{name}")
+        result["overrides"] = overrides
+        print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Optical-flow inference micro-batch sweep on one chip.
+
+Times the official 41M optical-flow model on one Sintel frame pair (6 patches
+at 368x496) processed in micro-batches of k patches, k in --micro-batches.
+Prints one JSON line per k, comparable to bench.py --task optical_flow (which
+is the k=6 point). The reference pipeline exposes the same knob as
+``micro_batch_size`` (reference vision/optical_flow/huggingface.py:95-106);
+this sweep records where the chip saturates so serving configs can pick the
+smallest k with full throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")  # repo root (bench.py)
+
+from bench import _OF_TARGET_FPS_PER_CHIP  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--micro-batches", type=int, nargs="+", default=[1, 2, 3, 6])
+    args = parser.parse_args()
+
+    from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
+    from perceiver_io_tpu.models.vision.optical_flow import (
+        OpticalFlow,
+        OpticalFlowConfig,
+        OpticalFlowDecoderConfig,
+        OpticalFlowEncoderConfig,
+    )
+
+    enc = OpticalFlowEncoderConfig(
+        image_shape=(368, 496), num_patch_input_channels=27,
+        num_patch_hidden_channels=64, num_frequency_bands=64,
+        num_cross_attention_heads=1, num_self_attention_heads=8,
+        num_self_attention_layers_per_block=24, num_self_attention_blocks=1,
+    )
+    dec = OpticalFlowDecoderConfig(
+        image_shape=(368, 496), num_cross_attention_qk_channels=512,
+        num_cross_attention_v_channels=512, num_cross_attention_heads=1,
+        cross_attention_residual=False,
+    )
+    cfg = OpticalFlowConfig(encoder=enc, decoder=dec, num_latents=2048, num_latent_channels=512)
+    model = OpticalFlow(config=cfg, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    proc = OpticalFlowProcessor(patch_size=(368, 496))
+    n_patches = len(proc.compute_patch_grid_indices((436, 1024)))
+    x = jax.random.normal(rng, (n_patches, 2, 27, 368, 496), jnp.bfloat16)
+    params = jax.jit(model.init)(rng, x[:1])
+    apply = jax.jit(lambda p, xx: model.apply(p, xx))
+
+    for k in args.micro_batches:
+        if n_patches % k:
+            continue
+        chunks = [x[i : i + k] for i in range(0, n_patches, k)]
+        outs = [apply(params, c) for c in chunks]
+        float(jnp.abs(outs[-1]).sum())  # compile + sync (bench.py sync note)
+        best = float("inf")
+        n_pairs = 3
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_pairs):
+                outs = [apply(params, c) for c in chunks]
+            float(sum(jnp.abs(o).sum() for o in outs))
+            best = min(best, time.perf_counter() - t0)
+        fps = n_pairs / best
+        print(json.dumps({
+            "metric": f"optical_flow_sintel_fps_micro_batch_{k}",
+            "value": round(fps, 3),
+            "unit": "frame_pairs/s",
+            "vs_baseline": round(fps / _OF_TARGET_FPS_PER_CHIP, 4),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
