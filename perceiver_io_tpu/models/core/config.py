@@ -108,6 +108,11 @@ class PerceiverARConfig:
     # mesh axis name for sequence-parallel ring attention over the prefix/latent
     # sequences (long-context training beyond one chip's memory); None = off
     sequence_parallel_axis: Optional[str] = None
+    # mesh axis name for GPipe pipeline parallelism over the self-attention
+    # stack (layer-sharded params + microbatched shard_map schedule,
+    # parallel/pipeline.py); None = off. Pure execution knob like fused_qkv.
+    pipeline_axis: Optional[str] = None
+    pipeline_microbatches: Optional[int] = None  # default = stage count
 
     def base_kwargs(self, exclude=()):
         return _base_kwargs(self, PerceiverARConfig, exclude)

@@ -384,6 +384,12 @@ class SelfAttentionBlock(nn.Module):
     scan_unroll: int = 1  # lax.scan unroll factor for the layer loop; config-
     # dependent: -10% on the 30M config (scan 176.6k vs unroll=8 159.4k tok/s)
     # but +2.9 MFU points on the 455M flagship at full unroll (NOTES.md)
+    # GPipe pipeline parallelism over this mesh axis: the stacked layer params
+    # shard over it and microbatches flow stage-to-stage (parallel/pipeline.py).
+    # Pure execution knob — params/checkpoints unchanged; decode (kv_cache)
+    # paths fall back to the scanned loop.
+    pipeline_axis: Optional[str] = None
+    pipeline_microbatches: Optional[int] = None  # default = stage count
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -417,6 +423,14 @@ class SelfAttentionBlock(nn.Module):
         rope_gates = jnp.asarray(use_rope, dtype=jnp.float32)
 
         policy = _remat_policy(self.remat_policy, self.activation_checkpointing)
+
+        if self.pipeline_axis is not None and kv_cache is None and not self.is_initializing():
+            from perceiver_io_tpu.parallel.pipeline import pipeline_mesh_plan
+
+            plan = pipeline_mesh_plan(self.pipeline_axis)
+            if plan is not None:
+                return self._pipelined(plan, x, rope_gates, rope_q, rope_k, pad_mask, policy)
+
         layer_cls = SelfAttentionLayer
         if self.activation_checkpointing:
             layer_cls = nn.remat(layer_cls, policy=policy)
@@ -430,7 +444,15 @@ class SelfAttentionBlock(nn.Module):
             length=self.num_layers,
             unroll=max(1, min(self.scan_unroll, self.num_layers)),
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(
+        )(**self._layer_kwargs(), name="layers")
+        return scanned(x, rope_gates, kv_cache, rope_q, rope_k, pad_mask)
+
+    def _layer_kwargs(self, **overrides):
+        """The single source of SelfAttentionLayer construction kwargs — shared
+        by the scanned path and the pipeline path so the two cannot drift (a
+        field present in one but not the other would silently change numerics
+        between the execution modes)."""
+        kwargs = dict(
             num_heads=self.num_heads,
             num_channels=self.num_channels,
             num_qk_channels=self.num_qk_channels,
@@ -449,9 +471,59 @@ class SelfAttentionBlock(nn.Module):
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
-            name="layers",
         )
-        return scanned(x, rope_gates, kv_cache, rope_q, rope_k, pad_mask)
+        kwargs.update(overrides)
+        return kwargs
+
+    def _pipelined(self, plan, x, rope_gates, rope_q, rope_k, pad_mask, policy):
+        """GPipe path: apply the already-initialized stacked layer params as a
+        pure function inside the pipeline shard_map (parallel/pipeline.py). The
+        scanned module above creates/owns the params (init and every
+        non-pipelined apply); this path only READS them, so checkpoints and the
+        param tree are identical with the knob on or off."""
+        from perceiver_io_tpu.parallel.pipeline import pipeline_layer_stack
+
+        num_stages, batch_axes = plan
+        stacked = self.get_variable("params", "layers")
+
+        needs_rng = (self.dropout > 0.0 or self.residual_dropout > 0.0) and not self.deterministic
+        keys = jax.random.split(self.make_rng("dropout"), self.num_layers) if needs_rng else None
+
+        b = x.shape[0]
+        present = tuple(a is not None for a in (rope_q, rope_k, pad_mask))
+        extra = tuple(
+            a if a.shape[0] == b else jnp.broadcast_to(a, (b, *a.shape[1:]))
+            for a in (rope_q, rope_k, pad_mask)
+            if a is not None
+        )
+
+        # seq_axis off inside the pipeline shard (pipeline_mesh_plan rejects
+        # meshes with a >1 seq axis; a leftover config value must not trigger
+        # ring-attention mesh validation inside the stage computation)
+        layer = SelfAttentionLayer(**self._layer_kwargs(seq_axis=None))
+
+        def layer_apply(p, rng, h, gate, *ex):
+            it = iter(ex)
+            rq, rk, pm = (next(it) if have else None for have in present)
+            rngs = None if rng is None else {"dropout": rng}
+            out, _ = layer.apply({"params": p}, h, gate, None, rq, rk, pm, rngs=rngs)
+            return out
+
+        y = pipeline_layer_stack(
+            layer_apply,
+            stacked,
+            x,
+            rope_gates,
+            keys,
+            num_stages=num_stages,
+            batch_axes=batch_axes,
+            pipe_axis=self.pipeline_axis,
+            num_microbatches=self.pipeline_microbatches,
+            remat=self.activation_checkpointing,
+            remat_policy=policy,
+            extra=extra,
+        )
+        return y, None
 
 
 class PerceiverEncoder(nn.Module):

@@ -102,6 +102,8 @@ class PerceiverAR(nn.Module):
     fused_qkv: bool = False  # single-GEMM q/k/v projections (execution knob; NOTES.md)
     init_scale: float = 0.02
     sequence_parallel_axis: Optional[str] = None  # mesh axis for ring attention (long context)
+    pipeline_axis: Optional[str] = None  # mesh axis for GPipe over the SA stack (parallel/pipeline.py)
+    pipeline_microbatches: Optional[int] = None
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -146,6 +148,8 @@ class PerceiverAR(nn.Module):
             mlp_bias=False,
             init_scale=self.init_scale,
             seq_axis=self.sequence_parallel_axis,
+            pipeline_axis=self.pipeline_axis,
+            pipeline_microbatches=self.pipeline_microbatches,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -371,6 +375,8 @@ class CausalSequenceModel(nn.Module):
             cross_attention_dropout_mode=cfg.cross_attention_dropout_mode,
             post_attention_dropout=cfg.post_attention_dropout,
             sequence_parallel_axis=cfg.sequence_parallel_axis,
+            pipeline_axis=cfg.pipeline_axis,
+            pipeline_microbatches=cfg.pipeline_microbatches,
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,

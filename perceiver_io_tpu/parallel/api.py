@@ -25,31 +25,36 @@ from perceiver_io_tpu.parallel.sharding import (
 ParallelMode = Literal["dp", "fsdp"]
 
 
-def _infer_state_shardings(state_or_shapes, mesh: Mesh, mode: ParallelMode, min_fsdp_size: int):
+def _infer_state_shardings(state_or_shapes, mesh: Mesh, mode: ParallelMode, min_fsdp_size: int, pipeline_axis="pipe"):
     """Sharding tree for a TrainState (concrete or jax.eval_shape result)."""
     if mode == "dp":
         param_sh = replicated_shardings(state_or_shapes.params, mesh)
     else:
-        param_sh = infer_param_shardings(state_or_shapes.params, mesh, min_fsdp_size=min_fsdp_size)
+        param_sh = infer_param_shardings(
+            state_or_shapes.params, mesh, min_fsdp_size=min_fsdp_size, pipeline_axis=pipeline_axis
+        )
     return state_shardings(state_or_shapes, param_sh, mesh)
 
 
-def shard_train_state(state, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12):
+def shard_train_state(state, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12,
+                      pipeline_axis="pipe"):
     """Place a host-resident TrainState onto the mesh; returns (sharded_state,
-    sharding_tree) — the latter feeds jit in/out_shardings."""
-    state_sh = _infer_state_shardings(state, mesh, mode, min_fsdp_size)
+    sharding_tree) — the latter feeds jit in/out_shardings. ``pipeline_axis``:
+    see infer_param_shardings (match the model's config; None = no pipelining)."""
+    state_sh = _infer_state_shardings(state, mesh, mode, min_fsdp_size, pipeline_axis)
     sharded = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_sh)
     return sharded, state_sh
 
 
-def create_sharded_state(state_fn: Callable, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12):
+def create_sharded_state(state_fn: Callable, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12,
+                         pipeline_axis="pipe"):
     """Materialize ``state_fn()`` (a zero-arg TrainState factory) directly onto
     the mesh: the factory is traced with ``jax.eval_shape`` to infer shardings,
     then jitted with ``out_shardings`` so every parameter and optimizer moment
     comes out sharded — no host-resident full copy, no replicate-then-reshard
     step (the device_put path in shard_train_state). Returns (state, shardings)."""
     state_shape = jax.eval_shape(state_fn)
-    state_sh = _infer_state_shardings(state_shape, mesh, mode, min_fsdp_size)
+    state_sh = _infer_state_shardings(state_shape, mesh, mode, min_fsdp_size, pipeline_axis)
     with jax.sharding.set_mesh(mesh):
         state = jax.jit(state_fn, out_shardings=state_sh)()
     return state, state_sh
@@ -62,13 +67,15 @@ def create_sharded_train_state(
     mode: ParallelMode = "fsdp",
     min_fsdp_size: int = 2**12,
     rng=None,
+    pipeline_axis="pipe",
 ):
     """create_sharded_state over ``TrainState.create(init_fn(), tx)`` where
     ``init_fn`` is a zero-arg closure returning the param tree."""
     from perceiver_io_tpu.training.trainer import TrainState
 
     return create_sharded_state(
-        lambda: TrainState.create(init_fn(), tx, rng=rng), mesh, mode=mode, min_fsdp_size=min_fsdp_size
+        lambda: TrainState.create(init_fn(), tx, rng=rng), mesh, mode=mode, min_fsdp_size=min_fsdp_size,
+        pipeline_axis=pipeline_axis,
     )
 
 

@@ -14,6 +14,8 @@ Canonical axis names:
                 finer-grained data axis)
   - ``tensor``  Megatron-style head/width sharding
   - ``seq``     sequence/context parallelism for long inputs
+  - ``pipe``    GPipe pipeline parallelism over the scanned layer stack
+                (parallel/pipeline.py; layer-sharded params + microbatches)
 """
 
 from __future__ import annotations

@@ -97,17 +97,31 @@ def _spec_for(
 SCAN_MODULE_NAME = "layers"
 
 
-def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12):
+def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12, pipeline_axis: str = "pipe"):
     """NamedSharding pytree for a param tree: tensor rules first, then FSDP on the
     largest divisible dim of every sufficiently large parameter; small params
     replicate. Scan-stacked params (under ``layers``) never shard their leading
-    layer axis — slicing a sharded scan axis would turn every loop iteration into
-    a cross-device gather."""
+    layer axis over fsdp/tensor — slicing a sharded scan axis would turn every
+    loop iteration into a cross-device gather — but DO shard it over the
+    ``pipeline_axis`` when the mesh has one: pipeline parallelism places whole
+    layers per stage and never slices across them (parallel/pipeline.py).
+    ``pipeline_axis`` must match the model's ``pipeline_axis`` config: pass the
+    config value when it differs from the default "pipe", and pass None for a
+    mesh that has a >1 axis of that name while the model does NOT pipeline —
+    pipe-sharding a stack the scanned layer loop will slice would gather it
+    from across the mesh every iteration."""
+    has_pipe = pipeline_axis in mesh.axis_names and mesh.shape[pipeline_axis] > 1
 
     def f(path, value):
         keys = tuple(getattr(k, "key", str(k)) for k in path)
-        exclude = (0,) if SCAN_MODULE_NAME in keys else ()
-        return NamedSharding(mesh, _spec_for(keys, value, mesh, min_fsdp_size, exclude_dims=exclude))
+        is_scanned = SCAN_MODULE_NAME in keys
+        exclude = (0,) if is_scanned else ()
+        spec = _spec_for(keys, value, mesh, min_fsdp_size, exclude_dims=exclude)
+        if is_scanned and has_pipe and np.shape(value)[0] % mesh.shape[pipeline_axis] == 0:
+            axes = list(spec) + [None] * (np.ndim(value) - len(spec))
+            axes[0] = pipeline_axis
+            spec = PartitionSpec(*axes)
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(f, params)
 
