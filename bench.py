@@ -13,6 +13,9 @@ vs_baseline is measured MFU against the BASELINE.json north star of 40% MFU
 Other tasks:
   ``--task clm_30m``       the 30.7M WikiText CLM config (seq 4096); small ops
                            make it platform-overhead-bound here (see NOTES.md)
+  ``--task clm_8k``        long-context: the Perceiver AR paper's 8k regime
+                           (seq 8192, 1024 latents) trained on ONE chip via
+                           latent compression + dots-saveable remat
   ``--task optical_flow``  Perceiver IO optical-flow inference at the official
                            deepmind/optical-flow-perceiver dims (41M params) on
                            Sintel-resolution 436x1024 frame pairs — the second
@@ -105,6 +108,24 @@ def bench_clm_30m():
     )
     return _bench_clm_config(config, batch_size=8, n_steps=10,
                              metric="perceiver_ar_clm_30m_train_tokens_per_sec_per_chip")
+
+
+def bench_clm_8k():
+    """Long-context single-chip training: the Perceiver AR paper's 8k regime
+    (seq 8192, 1024 latents) on the 30M-class architecture — latent compression
+    is what keeps 8k-context training feasible on ONE chip (NOTES.md measured
+    139k latent tokens/s / 15.6% MFU); contexts beyond one chip's HBM use ring
+    attention (sequence_parallel_axis) instead."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+
+    config = CausalSequenceModelConfig(
+        vocab_size=262, max_seq_len=8192, max_latents=1024, num_channels=512,
+        num_heads=8, num_self_attention_layers=8, cross_attention_dropout=0.5,
+        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
+        fused_qkv=True,
+    )
+    return _bench_clm_config(config, batch_size=4, n_steps=5,
+                             metric="perceiver_ar_clm_8k_longcontext_train_tokens_per_sec_per_chip")
 
 
 # Fixed external target for the optical-flow task (BASELINE.json north star:
@@ -237,9 +258,10 @@ def main():
     if "--task" in args:
         idx = args.index("--task")
         if idx + 1 >= len(args):
-            sys.exit("--task requires a value: clm | clm_30m | optical_flow | decode")
+            sys.exit("--task requires a value: clm | clm_30m | clm_8k | optical_flow | decode")
         task = args[idx + 1]
-    benches = {"clm": bench_clm_455m, "clm_30m": bench_clm_30m, "optical_flow": bench_optical_flow, "decode": bench_decode}
+    benches = {"clm": bench_clm_455m, "clm_30m": bench_clm_30m, "clm_8k": bench_clm_8k,
+               "optical_flow": bench_optical_flow, "decode": bench_decode}
     if task not in benches:
         sys.exit(f"unknown --task {task!r}: expected one of {sorted(benches)}")
     print(json.dumps(benches[task]()))

@@ -90,6 +90,12 @@ class DataLoader:
             # restore can replay the identical order
             self._epoch_start_rng_state = self.rng.bit_generator.state
             self._consumed = 0
+            # epoch hook for self-refreshing datasets (e.g. synthetic corpora
+            # that draw fresh windows per epoch). Not called on a mid-epoch
+            # restore: the dataset's own state_dict re-materializes its epoch.
+            hook = getattr(self.dataset, "on_epoch_start", None)
+            if hook is not None:
+                hook()
         order = self.rng.permutation(n) if self.shuffle else np.arange(n)
         stop = n - (n % self.batch_size) if self.drop_last else n
         skip, self._skip = self._skip, 0

@@ -164,6 +164,47 @@ class _ChainWindows:
         return {"input_ids": self.x[idx], "labels": self.y[idx]}
 
 
+class _FreshChainWindows:
+    """Train-side chain windows redrawn FRESH each epoch via the DataLoader's
+    ``on_epoch_start`` hook: epoch e is materialized deterministically from rng
+    key ``[seed, 815, e]`` (the 815 namespace cannot collide with the fixed
+    validation key ``seed + 2`` for any seed), so the training stream never
+    repeats — a fixed finite sample lets the model drive train CE below the
+    analytic floor by memorization — while staying exact-resume compatible:
+    ``state_dict`` records the epoch index and ``load_state_dict``
+    re-materializes the identical windows."""
+
+    def __init__(self, src: "MarkovByteSource", n_windows: int, window_len: int, seed: int):
+        self.src, self.n_windows, self.window_len, self.base_seed = src, n_windows, window_len, seed
+        self.epoch = -1  # first on_epoch_start -> epoch 0
+        self.x = self.y = None
+
+    def _materialize(self) -> None:
+        w = self.src.sample_windows(self.n_windows, self.window_len, seed=[self.base_seed, 815, self.epoch])
+        self.x = w[:, :-1].astype(np.int32)
+        self.y = w[:, 1:].astype(np.int32)
+
+    def on_epoch_start(self) -> None:
+        self.epoch += 1
+        self._materialize()
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        if self.epoch >= 0:
+            self._materialize()
+
+    def __len__(self):
+        return self.n_windows
+
+    def __getitem__(self, idx):
+        if self.x is None:  # direct iteration without a loader epoch hook
+            self.on_epoch_start()
+        return {"input_ids": self.x[idx], "labels": self.y[idx]}
+
+
 class _WindowDataset:
     """Non-overlapping fixed-length windows with next-token labels."""
 
@@ -211,13 +252,13 @@ class SyntheticTextDataModule:
             src = MarkovByteSource(vocab_size=self.vocab_size, concentration=self.concentration, seed=self.seed)
             self.entropy_floor = src.entropy_floor()
             self._markov_src = src
-            # independent stationary windows, redrawn fresh each epoch by
-            # train_dataloader: the training stream never repeats, so training
-            # CE cannot be driven below the floor by memorizing a fixed sample
-            # (observed with the old fixed 1M-token corpus: train CE 0.85 vs
-            # floor 1.23 while validation CE climbed)
+            # independent stationary windows, redrawn fresh each epoch through
+            # the DataLoader's on_epoch_start hook: the training stream never
+            # repeats, so training CE cannot be driven below the floor by
+            # memorizing a fixed sample (observed with the old fixed 1M-token
+            # corpus: train CE 0.85 vs floor 1.23 while validation CE climbed)
             n_windows = max(self.n_train_tokens // self.seq_len, 1)
-            self.ds_train = _ChainWindows(src.sample_windows(n_windows, self.seq_len + 1, seed=self.seed + 1))
+            self.ds_train = _FreshChainWindows(src, n_windows, self.seq_len + 1, self.seed)
             n_val = max(self.n_val_tokens // self.seq_len, 1)
             self.ds_valid = _ChainWindows(src.sample_windows(n_val, self.seq_len + 1, seed=self.seed + 2))
             return
@@ -247,11 +288,6 @@ class SyntheticTextDataModule:
 
     def train_dataloader(self) -> DataLoader:
         loader_rng = np.random.default_rng(self._rng.integers(0, 2**63))
-        if self.source == "markov":
-            fresh = int(self._rng.integers(3, 2**31))  # 3.. keeps clear of the fixed val/init seeds
-            self.ds_train = _ChainWindows(
-                self._markov_src.sample_windows(len(self.ds_train), self.seq_len + 1, seed=fresh)
-            )
         return DataLoader(self.ds_train, self.batch_size, collate_fn=self._collate, shuffle=self.shuffle, rng=loader_rng)
 
     def val_dataloader(self) -> DataLoader:

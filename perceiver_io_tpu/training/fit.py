@@ -50,6 +50,14 @@ class TrainerConfig:
     tokens_per_batch: Optional[int] = None  # enables tokens/sec telemetry
     flops_per_step: Optional[float] = None  # enables MFU telemetry (see training.flops)
     peak_flops: Optional[float] = None
+    # device-trace capture (SURVEY.md §5 tracing: the reference had none; here
+    # it is one config knob): a jax.profiler trace of steps
+    # [profile_start_step, profile_start_step + profile_steps) is written to
+    # profile_dir, viewable in XProf/TensorBoard. start defaults past step 1 so
+    # the compile is not in the trace.
+    profile_dir: Optional[str] = None
+    profile_start_step: int = 3
+    profile_steps: int = 5
 
 
 class Trainer:
@@ -105,13 +113,25 @@ class Trainer:
         stateful = hasattr(first_source, "state_dict")
         self._train_source = first_source if stateful else None
 
+        profiling = False
         while step_count < cfg.max_steps:
             epoch_source = first_source if stateful else train_loader_fn()
             self._train_source = epoch_source if stateful else None
             for batch in epoch_source:
+                if cfg.profile_dir and step_count == cfg.profile_start_step and not profiling:
+                    jax.block_until_ready(state.params)  # trace device work of OUR steps only
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
                 state, metrics = step_fn(state, put(batch))
                 step_count += 1
                 window_steps += 1
+
+                if profiling and step_count >= cfg.profile_start_step + cfg.profile_steps:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    self.log(json.dumps({"step": step_count, "profile_trace": cfg.profile_dir}))
+                    window_t0, window_steps = time.perf_counter(), 0  # exclude trace IO
 
                 if step_count % cfg.log_every == 0:
                     loss = float(metrics["loss"])
@@ -144,6 +164,8 @@ class Trainer:
                 if step_count >= cfg.max_steps:
                     break
 
+        if profiling:  # max_steps inside the profile window
+            jax.profiler.stop_trace()
         if cfg.checkpoint_dir:
             save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
             self._save_iterator_state("last_iterator.json")

@@ -108,6 +108,26 @@ def test_trainer_fit_loop_with_eval_and_best_checkpoint(tmp_path):
     assert int(restored.step) == 50
 
 
+def test_trainer_profile_trace_capture(tmp_path):
+    """profile_dir captures a jax.profiler device trace of the configured step
+    window (SURVEY.md §5 tracing — one TrainerConfig knob here)."""
+    import glob
+
+    init_fn, tx, train_step, eval_step, loader = tiny_fit_setup()
+    state = TrainState.create(init_fn(), tx)
+    logs = []
+    prof_dir = str(tmp_path / "trace")
+    trainer = Trainer(
+        TrainerConfig(max_steps=12, eval_every=100, log_every=100, profile_dir=prof_dir,
+                      profile_start_step=2, profile_steps=4),
+        log_fn=lambda line: logs.append(json.loads(line)),
+    )
+    trainer.fit(state, train_step, loader, eval_step=eval_step, eval_loader_fn=loader)
+    traces = glob.glob(os.path.join(prof_dir, "**", "*.trace.json.gz"), recursive=True)
+    assert traces, f"no trace written under {prof_dir}"
+    assert any("profile_trace" in l for l in logs)
+
+
 def test_best_metric_survives_resume(tmp_path):
     """A resumed run must keep competing against the previous run's best
     checkpoint: _maybe_checkpoint persists the monitor value, fit(initial_best=)
