@@ -1,11 +1,16 @@
 """Benchmark: Perceiver AR causal-LM training throughput on one TPU chip.
 
-Default task runs the reference's published flagship — the 455M C4 Perceiver AR
-(examples/training/clm/train_fsdp.sh: 20 layers x 1280, heads 10, seq 1024,
-latents 512, xlnet 32k vocab, bf16, remat) — as a jitted train step and prints
-ONE JSON line:
+With no args (driver mode) a hardened orchestrator probes backend init with
+retries/backoff, runs the headline + optical_flow + decode tasks in isolated
+subprocesses (per-task records printed as they land), and ends with ONE JSON
+line — the headline record plus a "tasks" field carrying all three:
 
-  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": MFU/0.40}
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": MFU/0.40,
+   "tasks": {...}}
+
+The headline is the reference's published flagship — the 455M C4 Perceiver AR
+(examples/training/clm/train_fsdp.sh: 20 layers x 1280, heads 10, seq 1024,
+latents 512, xlnet 32k vocab, bf16, remat) — as a jitted train step.
 
 vs_baseline is measured MFU against the BASELINE.json north star of 40% MFU
 (the reference publishes no throughput numbers to compare against directly).
@@ -33,6 +38,7 @@ Other tasks:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -252,19 +258,124 @@ def bench_decode():
     }
 
 
+BENCHES = {"clm": bench_clm_455m, "clm_30m": bench_clm_30m, "clm_8k": bench_clm_8k,
+           "optical_flow": bench_optical_flow, "decode": bench_decode}
+
+# ---------------------------------------------------------------------------
+# Driver mode (no args): a hardened orchestrator.
+#
+# Round 2's lesson: the tunneled TPU backend can wedge (make_c_api_client
+# blocks forever) or fail transiently (UNAVAILABLE), and a single such failure
+# erased the round's entire perf record (BENCH_r02.json rc=1, no numbers).
+# The orchestrator therefore:
+#   1. probes backend init in a KILLABLE subprocess, retrying with backoff —
+#      in-process jax.devices() can hang unrecoverably;
+#   2. runs each task as an isolated subprocess with a timeout and one retry,
+#      printing its JSON record the moment it lands, so every task completed
+#      before a later failure is preserved in the artifact tail;
+#   3. ends with ONE headline JSON line (driver contract) carrying a "tasks"
+#      field with all per-task records.
+# ---------------------------------------------------------------------------
+
+_DRIVER_TASKS = ("clm", "optical_flow", "decode")
+_PROBE_TIMEOUT_S = 180
+_PROBE_BACKOFFS_S = (15, 30, 60, 120, 240)
+_TASK_TIMEOUT_S = {"clm": 1800, "optical_flow": 1500, "decode": 1800}
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_backend() -> bool:
+    """Initialize the accelerator backend in a subprocess (killable on hang),
+    retrying with backoff. Returns True once jax.devices() answers."""
+    import subprocess
+
+    code = "import jax; print('devices:', jax.devices(), flush=True)"
+    for attempt, backoff in enumerate((0,) + _PROBE_BACKOFFS_S):
+        if backoff:
+            _log(f"backend probe retry in {backoff}s (attempt {attempt + 1}/{1 + len(_PROBE_BACKOFFS_S)})")
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"backend init HUNG past {_PROBE_TIMEOUT_S}s (tunnel wedged?) — killed the probe")
+            continue
+        if proc.returncode == 0:
+            _log(proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "backend up")
+            return True
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        _log("backend init failed: " + " | ".join(tail))
+    return False
+
+
+def _run_task_subprocess(task: str):
+    """Run ``bench.py --task <task>`` isolated; returns (record | None, note)."""
+    import subprocess
+
+    timeout = _TASK_TIMEOUT_S.get(task, 1800)
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--task", task],
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"task {task}: attempt {attempt} timed out after {timeout}s")
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec, "ok"
+        tail = " | ".join((proc.stderr or proc.stdout).strip().splitlines()[-3:])
+        _log(f"task {task}: attempt {attempt} rc={proc.returncode}, no JSON record: {tail}")
+    return None, "failed after 2 attempts (see [bench] diagnostics above)"
+
+
+def _driver_main() -> int:
+    if not _probe_backend():
+        _log("UNRECOVERABLE: accelerator backend never initialized after "
+             f"{1 + len(_PROBE_BACKOFFS_S)} probes over ~{sum(_PROBE_BACKOFFS_S) // 60} min.")
+        _log("Diagnosis: the axon PJRT tunnel is down or wedged on this host — this is a platform "
+             "failure, not a framework one. Re-run `python bench.py` when the tunnel recovers; "
+             "each task also runs standalone via `python bench.py --task clm|optical_flow|decode`.")
+        return 1
+
+    records = {}
+    for task in _DRIVER_TASKS:
+        rec, note = _run_task_subprocess(task)
+        if rec is not None:
+            records[task] = rec
+            print(json.dumps(rec), flush=True)  # partial evidence survives later failures
+        else:
+            records[task] = {"task": task, "error": note}
+            _log(f"task {task}: {note}")
+
+    headline = records.get("clm")
+    if headline is None or "error" in headline:
+        _log("UNRECOVERABLE: headline task produced no record; see per-task diagnostics above.")
+        return 1
+    print(json.dumps({**headline, "tasks": records}), flush=True)
+    return 0
+
+
 def main():
-    task = "clm"
     args = sys.argv[1:]
-    if "--task" in args:
-        idx = args.index("--task")
-        if idx + 1 >= len(args):
-            sys.exit("--task requires a value: clm | clm_30m | clm_8k | optical_flow | decode")
-        task = args[idx + 1]
-    benches = {"clm": bench_clm_455m, "clm_30m": bench_clm_30m, "clm_8k": bench_clm_8k,
-               "optical_flow": bench_optical_flow, "decode": bench_decode}
-    if task not in benches:
-        sys.exit(f"unknown --task {task!r}: expected one of {sorted(benches)}")
-    print(json.dumps(benches[task]()))
+    if "--task" not in args:
+        sys.exit(_driver_main())
+    idx = args.index("--task")
+    if idx + 1 >= len(args):
+        sys.exit("--task requires a value: " + " | ".join(BENCHES))
+    task = args[idx + 1]
+    if task not in BENCHES:
+        sys.exit(f"unknown --task {task!r}: expected one of {sorted(BENCHES)}")
+    print(json.dumps(BENCHES[task]()))
 
 
 if __name__ == "__main__":

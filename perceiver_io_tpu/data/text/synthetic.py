@@ -98,8 +98,11 @@ class MarkovByteSource:
         tokens, vectorized across windows (a window_len-step loop instead of a
         per-token one — ~1000x faster than ``sample`` for corpus-scale draws).
         Each chain's (first, second) tokens come from the stationary pair
-        distribution, so every position's conditional entropy equals the
-        analytic floor exactly — and fresh windows can be drawn per epoch,
+        distribution, so every position with >= 2 tokens of context sits
+        exactly at the analytic floor; position 1 is predicted from a single
+        token of context, so H(w1|w0) exceeds the order-2 floor slightly
+        (harmless for the Perceiver AR loss, whose latent positions all have
+        >= 2 tokens of context) — and fresh windows can be drawn per epoch,
         eliminating the finite-corpus memorization gap that a fixed training
         sample develops (a model can drive its training CE below the floor by
         memorizing sampling noise; validation against fresh draws cannot)."""

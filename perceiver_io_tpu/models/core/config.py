@@ -72,7 +72,7 @@ class PerceiverIOConfig(Generic[E, D]):
     num_latent_channels: int
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
-    activation_offloading: bool = False  # accepted for parity; XLA remat has no CPU-offload knob here
+    activation_offloading: bool = False  # stage checkpointed dots to pinned host (modules._remat_policy)
 
 
 @dataclass(frozen=True)

@@ -57,12 +57,34 @@ _REMAT_POLICIES = (
 )
 
 
-def _remat_policy(name: Optional[str], activation_checkpointing: bool = True):
+def _remat_policy(name: Optional[str], activation_checkpointing: bool = True,
+                  activation_offloading: bool = False):
     """Resolve a jax.checkpoint_policies attribute by name (None = full remat).
     Policies like ``dots_with_no_batch_dims_saveable`` keep matmul outputs and
     recompute only the cheap elementwise ops in the backward pass — on the 455M
     flagship this is the difference between paying a full extra forward and
-    nearly none (see NOTES.md MFU table)."""
+    nearly none (see NOTES.md MFU table).
+
+    ``activation_offloading`` is the TPU-native equivalent of the reference's
+    ``offload_to_cpu`` checkpoint wrapper (reference core/modules.py:933-956,
+    torch CheckpointImpl + offload): instead of saving matmul outputs in HBM,
+    the ``offload_dot_with_no_batch_dims`` policy stages them to pinned host
+    memory during the forward pass and fetches them back for the backward —
+    trading HBM residency for PCIe/DMA traffic, which pays off when HBM is the
+    binding constraint (long-context configs; see NOTES.md)."""
+    if activation_offloading:
+        if not activation_checkpointing:
+            raise ValueError(
+                "activation_offloading requires activation_checkpointing=True "
+                "(offloading is a property of what the checkpoint saves)"
+            )
+        if name not in (None, "dots_with_no_batch_dims_saveable"):
+            raise ValueError(
+                f"activation_offloading composes with remat_policy=None or "
+                f"'dots_with_no_batch_dims_saveable' (it offloads exactly that "
+                f"policy's saveable set to host memory), got {name!r}"
+            )
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims("device", "pinned_host")
     if name is None:
         return None
     if name not in _REMAT_POLICIES:
@@ -375,6 +397,7 @@ class SelfAttentionBlock(nn.Module):
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name, e.g. "dots_with_no_batch_dims_saveable"
+    activation_offloading: bool = False  # stage checkpointed dots to pinned host (see _remat_policy)
     qkv_bias: bool = True
     fused_qkv: bool = False  # single-GEMM q/k/v (see MultiHeadAttention.fused_qkv)
     out_bias: bool = True
@@ -422,7 +445,7 @@ class SelfAttentionBlock(nn.Module):
         use_rope = (idx < self.num_rotary_layers) | (self.num_rotary_layers == -1)
         rope_gates = jnp.asarray(use_rope, dtype=jnp.float32)
 
-        policy = _remat_policy(self.remat_policy, self.activation_checkpointing)
+        policy = _remat_policy(self.remat_policy, self.activation_checkpointing, self.activation_offloading)
 
         if self.pipeline_axis is not None and kv_cache is None and not self.is_initializing():
             from perceiver_io_tpu.parallel.pipeline import pipeline_mesh_plan
@@ -552,6 +575,7 @@ class PerceiverEncoder(nn.Module):
     init_scale: float = 0.02
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
+    activation_offloading: bool = False  # stage checkpointed dots to pinned host (see _remat_policy)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -583,7 +607,9 @@ class PerceiverEncoder(nn.Module):
         def cross_attn(name):
             layer_cls = CrossAttentionLayer
             if self.activation_checkpointing:
-                layer_cls = nn.remat(layer_cls, policy=_remat_policy(self.remat_policy, True))
+                layer_cls = nn.remat(
+                    layer_cls, policy=_remat_policy(self.remat_policy, True, self.activation_offloading)
+                )
             return layer_cls(
                 num_heads=self.num_cross_attention_heads,
                 num_q_input_channels=self.num_latent_channels,
@@ -613,6 +639,7 @@ class PerceiverEncoder(nn.Module):
                 residual_dropout=self.residual_dropout,
                 activation_checkpointing=self.activation_checkpointing,
                 remat_policy=self.remat_policy,
+                activation_offloading=self.activation_offloading,
                 init_scale=self.init_scale,
                 deterministic=self.deterministic,
                 dtype=self.dtype,
@@ -670,12 +697,13 @@ class PerceiverDecoder(nn.Module):
     init_scale: float = 0.02
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
+    activation_offloading: bool = False  # stage checkpointed dots to pinned host (see _remat_policy)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
     def setup(self):
-        policy = _remat_policy(self.remat_policy, self.activation_checkpointing)
+        policy = _remat_policy(self.remat_policy, self.activation_checkpointing, self.activation_offloading)
         layer_cls = CrossAttentionLayer
         if self.activation_checkpointing:
             layer_cls = nn.remat(layer_cls, policy=policy)

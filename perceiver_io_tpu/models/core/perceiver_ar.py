@@ -98,6 +98,7 @@ class PerceiverAR(nn.Module):
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None
+    activation_offloading: bool = False  # stage checkpointed dots to pinned host (modules._remat_policy)
     scan_unroll: int = 1
     fused_qkv: bool = False  # single-GEMM q/k/v projections (execution knob; NOTES.md)
     init_scale: float = 0.02
@@ -141,6 +142,7 @@ class PerceiverAR(nn.Module):
             num_rotary_layers=self.num_self_attention_rotary_layers,
             activation_checkpointing=self.activation_checkpointing,
             remat_policy=self.remat_policy,
+            activation_offloading=self.activation_offloading,
             scan_unroll=self.scan_unroll,
             qkv_bias=False,
             fused_qkv=self.fused_qkv,
@@ -380,6 +382,7 @@ class CausalSequenceModel(nn.Module):
             residual_dropout=cfg.residual_dropout,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            activation_offloading=cfg.activation_offloading,
             scan_unroll=cfg.scan_unroll,
             fused_qkv=cfg.fused_qkv,
             init_scale=cfg.init_scale,

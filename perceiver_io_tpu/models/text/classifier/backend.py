@@ -35,6 +35,7 @@ class TextClassifier(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            activation_offloading=cfg.activation_offloading,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -56,6 +57,7 @@ class TextClassifier(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            activation_offloading=cfg.activation_offloading,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -35,6 +35,7 @@ def make_text_encoder(
     num_latent_channels: int,
     activation_checkpointing: bool = False,
     remat_policy: Optional[str] = None,
+    activation_offloading: bool = False,
     deterministic: bool = True,
     dtype: Optional[jnp.dtype] = None,
     param_dtype: jnp.dtype = jnp.float32,
@@ -54,6 +55,7 @@ def make_text_encoder(
         num_latent_channels=num_latent_channels,
         activation_checkpointing=activation_checkpointing,
         remat_policy=remat_policy,
+        activation_offloading=activation_offloading,
         deterministic=deterministic,
         dtype=dtype,
         param_dtype=param_dtype,

@@ -98,6 +98,7 @@ class ImageClassifier(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            activation_offloading=cfg.activation_offloading,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -121,6 +122,7 @@ class ImageClassifier(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            activation_offloading=cfg.activation_offloading,
             deterministic=self.deterministic,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -25,7 +25,7 @@ from perceiver_io_tpu.parallel.sharding import (
 ParallelMode = Literal["dp", "fsdp"]
 
 
-def _infer_state_shardings(state_or_shapes, mesh: Mesh, mode: ParallelMode, min_fsdp_size: int, pipeline_axis="pipe"):
+def _infer_state_shardings(state_or_shapes, mesh: Mesh, mode: ParallelMode, min_fsdp_size: int, pipeline_axis=None):
     """Sharding tree for a TrainState (concrete or jax.eval_shape result)."""
     if mode == "dp":
         param_sh = replicated_shardings(state_or_shapes.params, mesh)
@@ -37,17 +37,18 @@ def _infer_state_shardings(state_or_shapes, mesh: Mesh, mode: ParallelMode, min_
 
 
 def shard_train_state(state, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12,
-                      pipeline_axis="pipe"):
+                      pipeline_axis=None):
     """Place a host-resident TrainState onto the mesh; returns (sharded_state,
     sharding_tree) — the latter feeds jit in/out_shardings. ``pipeline_axis``:
-    see infer_param_shardings (match the model's config; None = no pipelining)."""
+    opt-in, must match the model's config (see infer_param_shardings; both
+    default to None = no pipelining)."""
     state_sh = _infer_state_shardings(state, mesh, mode, min_fsdp_size, pipeline_axis)
     sharded = jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_sh)
     return sharded, state_sh
 
 
 def create_sharded_state(state_fn: Callable, mesh: Mesh, mode: ParallelMode = "fsdp", min_fsdp_size: int = 2**12,
-                         pipeline_axis="pipe"):
+                         pipeline_axis=None):
     """Materialize ``state_fn()`` (a zero-arg TrainState factory) directly onto
     the mesh: the factory is traced with ``jax.eval_shape`` to infer shardings,
     then jitted with ``out_shardings`` so every parameter and optimizer moment
@@ -67,7 +68,7 @@ def create_sharded_train_state(
     mode: ParallelMode = "fsdp",
     min_fsdp_size: int = 2**12,
     rng=None,
-    pipeline_axis="pipe",
+    pipeline_axis=None,
 ):
     """create_sharded_state over ``TrainState.create(init_fn(), tx)`` where
     ``init_fn`` is a zero-arg closure returning the param tree."""

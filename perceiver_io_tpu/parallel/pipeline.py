@@ -94,8 +94,25 @@ def pipeline_layer_stack(
     if L % num_stages:
         raise ValueError(f"num_layers ({L}) not divisible by pipeline stages ({num_stages})")
     M = num_microbatches or num_stages
-    if x.shape[0] % M:
-        raise ValueError(f"batch {x.shape[0]} not divisible by num_microbatches ({M})")
+    # local_fn reshapes the PER-DATA-SHARD batch, not the global one: with a >1
+    # data axis the check must divide by the batch-axis mesh extent first, or
+    # (e.g.) B=4, data=4, M=2 passes here and dies at trace time with an opaque
+    # zero-sized reshape inside shard_map.
+    mesh = jax.sharding.get_abstract_mesh()
+    n_data_shards = 1
+    for a in batch_axes:
+        if mesh is not None and a in mesh.axis_names:
+            n_data_shards *= mesh.shape[a]
+    local_batch, rem = divmod(x.shape[0], n_data_shards)
+    if rem:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by the data-axis shard count ({n_data_shards})"
+        )
+    if local_batch % M:
+        raise ValueError(
+            f"per-data-shard batch {local_batch} (global {x.shape[0]} / {n_data_shards} shards) "
+            f"not divisible by num_microbatches ({M})"
+        )
 
     layer_fn = layer_apply
     if remat:

@@ -97,20 +97,23 @@ def _spec_for(
 SCAN_MODULE_NAME = "layers"
 
 
-def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12, pipeline_axis: str = "pipe"):
+def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12, pipeline_axis: str | None = None):
     """NamedSharding pytree for a param tree: tensor rules first, then FSDP on the
     largest divisible dim of every sufficiently large parameter; small params
     replicate. Scan-stacked params (under ``layers``) never shard their leading
     layer axis over fsdp/tensor — slicing a sharded scan axis would turn every
-    loop iteration into a cross-device gather — but DO shard it over the
-    ``pipeline_axis`` when the mesh has one: pipeline parallelism places whole
+    loop iteration into a cross-device gather — but DO shard it over
+    ``pipeline_axis`` when one is given: pipeline parallelism places whole
     layers per stage and never slices across them (parallel/pipeline.py).
-    ``pipeline_axis`` must match the model's ``pipeline_axis`` config: pass the
-    config value when it differs from the default "pipe", and pass None for a
-    mesh that has a >1 axis of that name while the model does NOT pipeline —
-    pipe-sharding a stack the scanned layer loop will slice would gather it
-    from across the mesh every iteration."""
-    has_pipe = pipeline_axis in mesh.axis_names and mesh.shape[pipeline_axis] > 1
+    ``pipeline_axis`` is opt-in and must MATCH the model's ``pipeline_axis``
+    config (both default None): layer-sharding the stack of a model whose
+    scanned loop slices it would gather the stack from across the mesh every
+    iteration — exactly the cliff the default now rules out."""
+    has_pipe = (
+        pipeline_axis is not None
+        and pipeline_axis in mesh.axis_names
+        and mesh.shape[pipeline_axis] > 1
+    )
 
     def f(path, value):
         keys = tuple(getattr(k, "key", str(k)) for k in path)

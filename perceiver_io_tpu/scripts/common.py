@@ -109,7 +109,10 @@ def run_fit(
                 from perceiver_io_tpu.training.checkpoint import restore_checkpoint
 
                 mesh = make_mesh(trainer_cfg.mesh_axes)
-                state_sh = _infer_state_shardings(template, mesh, trainer_cfg.parallel_mode, 2**12)
+                state_sh = _infer_state_shardings(
+                    template, mesh, trainer_cfg.parallel_mode, 2**12,
+                    pipeline_axis=trainer_cfg.pipeline_axis,
+                )
                 state = restore_checkpoint(last, template, shardings=state_sh)
             else:
                 state = Trainer.restore(last, template)

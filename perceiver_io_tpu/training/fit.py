@@ -47,6 +47,9 @@ class TrainerConfig:
     monitor_mode: str = "min"
     mesh_axes: Optional[Dict[str, int]] = None  # e.g. {"data": 2, "fsdp": 4}; None = single device
     parallel_mode: str = "fsdp"
+    # opt-in GPipe layer sharding: set to the model config's pipeline_axis (the
+    # two MUST agree — see parallel/sharding.py infer_param_shardings)
+    pipeline_axis: Optional[str] = None
     tokens_per_batch: Optional[int] = None  # enables tokens/sec telemetry
     flops_per_step: Optional[float] = None  # enables MFU telemetry (see training.flops)
     peak_flops: Optional[float] = None
@@ -87,9 +90,13 @@ class Trainer:
         if cfg.mesh_axes:
             mesh = make_mesh(cfg.mesh_axes)
             if callable(state):
-                state, state_sh = create_sharded_state(state, mesh, mode=cfg.parallel_mode)
+                state, state_sh = create_sharded_state(
+                    state, mesh, mode=cfg.parallel_mode, pipeline_axis=cfg.pipeline_axis
+                )
             else:
-                state, state_sh = shard_train_state(state, mesh, mode=cfg.parallel_mode)
+                state, state_sh = shard_train_state(
+                    state, mesh, mode=cfg.parallel_mode, pipeline_axis=cfg.pipeline_axis
+                )
             step_fn = make_sharded_train_step(train_step, mesh, state_sh)
             eval_fn = make_sharded_eval_step(eval_step, mesh, state_sh.params) if eval_step else None
             put = lambda b: jax.device_put(b, batch_sharding(mesh))

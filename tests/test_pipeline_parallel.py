@@ -98,7 +98,9 @@ def test_pipeline_sharded_train_state_losses_match(setup):
         ref_losses.append(float(m["loss"]))
 
     mesh = make_mesh({"data": 2, "pipe": 4}, devices=jax.devices()[:8])
-    state, state_sh = create_sharded_train_state(lambda: jax.tree.map(jnp.copy, params), tx, mesh, mode="fsdp")
+    state, state_sh = create_sharded_train_state(
+        lambda: jax.tree.map(jnp.copy, params), tx, mesh, mode="fsdp", pipeline_axis="pipe"
+    )
     # the scan-layer axis must actually be pipe-sharded by the partition rules
     layer_specs = jax.tree.leaves(
         jax.tree.map(lambda s: s.spec, state_sh.params["params"]["ar"]["self_attention"]["layers"])

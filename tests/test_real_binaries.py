@@ -1,0 +1,84 @@
+"""Real third-party-binary tier (VERDICT r2 item 7): the optional-dependency
+paths in test_optional_deps.py run against fakes so the logic never rots; THIS
+module runs the same paths against the REAL libraries whenever the image has
+them, mirroring the reference's tests that exercise actual cv2 / pretty_midi /
+fluidsynth (reference tests/optical_flow_pipeline_test.py:29,
+audio/symbolic/huggingface.py:127-190). Each test skips — with the concrete
+reason — when its binary is genuinely absent, so the tier is self-gating and
+portable."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+
+def test_real_cv2_video_roundtrip(tmp_path):
+    """write_video -> read_video_frames through actual OpenCV encode/decode:
+    frame count and geometry are exact; pixel values only approximate (lossy
+    codec), checked as mean error on large flat-color regions."""
+    pytest.importorskip("cv2", reason="real-cv2 tier: cv2 not installed")
+    from perceiver_io_tpu.data.vision import video_utils
+
+    rgb = [np.full((48, 64, 3), c, np.uint8) for c in (0, 80, 160, 240)]
+    path = tmp_path / "clip.mp4"
+    video_utils.write_video(path, rgb, fps=8)
+    assert path.stat().st_size > 0
+
+    frames = list(video_utils.read_video_frames(path))
+    assert len(frames) == len(rgb)
+    assert frames[0].shape == (48, 64, 3)
+    for got, want in zip(frames, rgb):
+        assert abs(float(got.mean()) - float(want.mean())) < 8.0  # codec loss only
+
+    pairs = list(video_utils.read_video_frame_pairs(path))
+    assert len(pairs) == len(rgb) - 1
+    np.testing.assert_array_equal(pairs[0][1], frames[1])
+
+
+def test_real_cv2_bgr_rgb_discipline(tmp_path):
+    """A frame that is red in RGB must come back red (not blue): catches a
+    missing/doubled cvtColor that the channel-reversing fake cannot."""
+    pytest.importorskip("cv2", reason="real-cv2 tier: cv2 not installed")
+    from perceiver_io_tpu.data.vision import video_utils
+
+    red = np.zeros((48, 64, 3), np.uint8)
+    red[..., 0] = 220  # RGB red channel
+    path = tmp_path / "red.mp4"
+    video_utils.write_video(path, [red] * 3, fps=8)
+    (frame, *_) = video_utils.read_video_frames(path)
+    assert float(frame[..., 0].mean()) > 150.0, "red channel lost - BGR/RGB order broken"
+    assert float(frame[..., 2].mean()) < 80.0, "blue channel high - frames came back as BGR"
+
+
+def test_real_pretty_midi_roundtrip(tmp_path):
+    """encode_midi/decode_midi through the real pretty_midi file format."""
+    pm = pytest.importorskip("pretty_midi", reason="real-midi tier: pretty_midi not installed")
+    from perceiver_io_tpu.data.audio import midi_processor as mp
+
+    midi = pm.PrettyMIDI()
+    inst = pm.Instrument(0)
+    inst.notes = [pm.Note(64, 60, 0.0, 0.5), pm.Note(80, 72, 0.25, 1.0)]
+    midi.instruments.append(inst)
+
+    tokens = mp.encode_midi(midi)
+    out_path = tmp_path / "gen.mid"
+    mp.decode_midi(tokens, file_path=str(out_path))
+    assert out_path.stat().st_size > 0
+
+    reloaded = pm.PrettyMIDI(str(out_path))
+    pitches = sorted(n.pitch for i in reloaded.instruments for n in i.notes)
+    assert pitches == [60, 72]
+
+
+def test_fluidsynth_presence_gate():
+    """The WAV-render path shells out to fluidsynth; when the binary exists the
+    command must at least resolve and print a version (a full render needs a
+    soundfont, which images rarely bundle)."""
+    import subprocess
+
+    binary = shutil.which("fluidsynth")
+    if binary is None:
+        pytest.skip("real-audio tier: fluidsynth binary not on PATH")
+    proc = subprocess.run([binary, "--version"], capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0 and "FluidSynth" in (proc.stdout + proc.stderr)
