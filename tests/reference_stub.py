@@ -17,6 +17,8 @@ def import_reference():
 
     import importlib.machinery
 
+    created = []
+
     def stub(name, attrs=()):
         if name in sys.modules:
             return sys.modules[name]
@@ -25,6 +27,7 @@ def import_reference():
         for a in attrs:
             setattr(mod, a, type(a, (), {}))
         sys.modules[name] = mod
+        created.append(name)
         return mod
 
     fs = stub("fairscale")
@@ -43,5 +46,31 @@ def import_reference():
     stub("pretty_midi", ["PrettyMIDI", "Note", "Instrument", "ControlChange"])
 
     import perceiver  # noqa: F401
+
+    # Eagerly load every reference subtree the tests draw from, while the
+    # stubs are still installed (the reference resolves these lazily, so a
+    # later `from perceiver.model.x import ...` in a test would otherwise
+    # re-trigger stub imports after cleanup below).
+    import importlib
+
+    for sub in (
+        "perceiver.model.core",
+        "perceiver.model.text.classifier",
+        "perceiver.model.text.common",
+        "perceiver.model.text.mlm",
+        "perceiver.model.vision.image_classifier",
+        "perceiver.model.vision.optical_flow.backend",
+        "perceiver.model.audio.symbolic.backend",
+    ):
+        importlib.import_module(sub)
+
+    # The reference's module tree now holds direct references to every stub it
+    # imported; dropping OUR stubs from sys.modules keeps them from shadowing
+    # genuine installs for the rest of the process (a bare `stub("cv2")` left
+    # in sys.modules made the real-binary tier's importorskip("cv2") find an
+    # empty husk instead of real OpenCV, or skip-proof a pretty_midi that was
+    # never installed). Modules that were already present are left untouched.
+    for name in created:
+        sys.modules.pop(name, None)
 
     return perceiver

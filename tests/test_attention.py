@@ -171,6 +171,7 @@ def test_fused_qkv_matches_unfused():
         np.testing.assert_array_equal(np.asarray(o_fused), np.asarray(o_plain))
 
 
+@pytest.mark.slow
 def test_fused_qkv_full_model():
     """CausalSequenceModel with fused_qkv=True reproduces the unfused logits
     from the same checkpoint (config knob flows through all layers)."""

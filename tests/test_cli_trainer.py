@@ -198,6 +198,7 @@ def test_periodic_checkpoint_survives_kill(tmp_path):
     assert int(restored.step) == 4  # the last periodic save before the kill
 
 
+@pytest.mark.slow
 def test_clm_cli_kill_and_resume(tmp_path, monkeypatch, capsys):
     """--resume continues a killed clm run bit-exact: the loss trajectory of
     (4 steps, kill, resume to 8) matches an uninterrupted 8-step run — state,

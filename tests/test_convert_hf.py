@@ -48,6 +48,7 @@ def official_language_perceiver_config():
     return transformers.PerceiverConfig(qk_channels=256, v_channels=1280)
 
 
+@pytest.mark.slow
 def test_language_perceiver_param_count():
     """The converted architecture must have exactly the official model's
     201,108,230 parameters (counted without downloading weights)."""

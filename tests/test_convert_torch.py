@@ -36,9 +36,15 @@ def assert_tree_matches(params, template):
         # the WikiText CLM flavor (reference examples/training/clm/train.py):
         dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=1),
         # the GiantMIDI symbolic-audio flavor (reference examples/training/sam):
-        dict(abs_pos_emb=False, output_norm=True, output_bias=False, num_self_attention_rotary_layers=-1),
+        pytest.param(
+            dict(abs_pos_emb=False, output_norm=True, output_bias=False, num_self_attention_rotary_layers=-1),
+            marks=pytest.mark.slow,
+        ),
         # the 455M C4 flavor (reference examples/training/clm/train_fsdp.sh):
-        dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=2),
+        pytest.param(
+            dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=2),
+            marks=pytest.mark.slow,
+        ),
     ],
 )
 def test_causal_sequence_model_conversion(variant):
@@ -114,9 +120,13 @@ def _my_text_enc_cfg(ref_cfg):
     return TextEncoderConfig(**d)
 
 
-@pytest.mark.parametrize("shared", [False, True])
-@pytest.mark.parametrize("tied", [True, False])
-def test_masked_language_model_conversion(shared, tied):
+@pytest.mark.parametrize("tied,shared", [
+    (True, False),
+    pytest.param(True, True, marks=pytest.mark.slow),
+    pytest.param(False, False, marks=pytest.mark.slow),
+    pytest.param(False, True, marks=pytest.mark.slow),
+])
+def test_masked_language_model_conversion(tied, shared):
     from perceiver.model.text.mlm import MaskedLanguageModel as RefMLM
     from perceiver.model.text.mlm import MaskedLanguageModelConfig as RefMLMConfig
     from perceiver.model.text.mlm import TextDecoderConfig as RefDec
@@ -211,7 +221,10 @@ def test_image_classifier_conversion():
     [
         # WikiText CLM flavor / 455M C4 flavor / GiantMIDI symbolic-audio flavor
         dict(abs_pos_emb=True, output_norm=True, output_bias=True, num_self_attention_rotary_layers=1),
-        dict(abs_pos_emb=False, output_norm=True, output_bias=False, num_self_attention_rotary_layers=-1),
+        pytest.param(
+            dict(abs_pos_emb=False, output_norm=True, output_bias=False, num_self_attention_rotary_layers=-1),
+            marks=pytest.mark.slow,
+        ),
     ],
 )
 def test_causal_sequence_model_export_roundtrip(variant):
@@ -251,6 +264,7 @@ def test_causal_sequence_model_export_roundtrip(variant):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_symbolic_audio_model_export_roundtrip():
     """Same roundtrip through the reference's SymbolicAudioModel class (MIDI
     vocab flavor; reference audio/symbolic/huggingface.py:176-200 parity)."""
@@ -283,6 +297,7 @@ def test_symbolic_audio_model_export_roundtrip():
     np.testing.assert_allclose(out, ref_out, atol=ATOL)
 
 
+@pytest.mark.slow
 def test_text_classifier_export_roundtrip():
     """flax -> reference-layout export for the classifier, through an encoder
     with repeated cross-attention and unshared blocks (cross_attn_n/self_attn_n)

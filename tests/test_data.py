@@ -326,6 +326,7 @@ def test_imagenet_preprocessor():
     assert cf.shape == (3, 224, 224)
 
 
+@pytest.mark.slow
 def test_parallel_prepare_matches_token_content(tmp_path):
     """preproc_workers > 1 shards tokenization across processes; the prepared
     chunks must contain the same token multiset as serial preparation (chunk
@@ -344,6 +345,7 @@ def test_parallel_prepare_matches_token_content(tmp_path):
     assert batch["input_ids"].shape[1] == 32
 
 
+@pytest.mark.slow
 def test_parallel_prepare_mlm_word_ids(tmp_path):
     dm = ToyTextDataModule(dataset_dir=str(tmp_path), max_seq_len=32, task=Task.mlm, preproc_workers=2)
     dm.prepare_data(); dm.setup()

@@ -30,7 +30,7 @@ def xla_reference(q, k_cache, v_cache, ang, q_pos, pad):
 @pytest.mark.parametrize(
     "b,h,d,cap,r,q_pos",
     [
-        (2, 4, 64, 1024, 32, 700),   # multi-block, partial rotary
+        pytest.param(2, 4, 64, 1024, 32, 700, marks=pytest.mark.slow),  # multi-block, partial rotary
         (1, 2, 32, 256, 32, 0),      # single block, r == d, only slot 0 visible
         (3, 2, 16, 128, 8, 127),     # full cache visible
     ],
@@ -65,9 +65,9 @@ def test_fused_decode_attention_per_batch_positions():
 @pytest.mark.parametrize(
     "b,h,d,cap,r,n_q,q_last",
     [
-        (2, 4, 64, 1024, 32, 4, 700),  # multi-block, partial rotary, mid-cache
+        pytest.param(2, 4, 64, 1024, 32, 4, 700, marks=pytest.mark.slow),  # multi-block, partial rotary, mid-cache
         (1, 2, 32, 256, 32, 8, 7),     # max n_q, queries at the very start
-        (2, 2, 16, 128, 8, 2, 127),    # full cache visible to the last query
+        pytest.param(2, 2, 16, 128, 8, 2, 127, marks=pytest.mark.slow),    # full cache visible to the last query
     ],
 )
 def test_fused_decode_attention_multi_query(b, h, d, cap, r, n_q, q_last):
@@ -144,6 +144,7 @@ def test_decode_kernel_supported_gates():
         del os.environ["PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL"]
 
 
+@pytest.mark.slow
 def test_full_model_decode_with_kernel_matches_plain(monkeypatch):
     """Force the fused-kernel branch (interpret mode) through the real
     MultiHeadAttention cached path: CausalSequenceModel.decode_step logits must

@@ -127,6 +127,7 @@ def test_beam_search(setup):
     np.testing.assert_array_equal(np.asarray(out[:, :8]), np.asarray(prompt))
 
 
+@pytest.mark.slow
 def test_beam_multinomial_sampling(setup):
     """do_sample=True with num_beams > 1 (HF beam_sample): reproducible under a
     fixed key, key-sensitive, and distinct from deterministic beam search."""
@@ -152,6 +153,7 @@ def test_beam_multinomial_sampling(setup):
     )  # and deviates from deterministic beam search
 
 
+@pytest.mark.slow
 def test_cached_equals_uncached_growth_regime(x64):
     """Greedy cached generate must match a token-by-token uncached loop while the
     latent count grows (prefix fixed) — exact in float64."""
@@ -204,6 +206,7 @@ def test_eos_stops_and_pads(setup):
     assert (after == 0).all()  # everything after EOS is pad
 
 
+@pytest.mark.slow
 def test_contrastive_search(setup):
     model, params, x = setup
     prompt = x[:, :8]

@@ -220,6 +220,7 @@ def test_render_wav_command(monkeypatch):
     assert cmd[1] == "/sf/font.sf2"  # soundfont inserted before flags
 
 
+@pytest.mark.slow
 def test_symbolic_audio_pipeline_midi_path_input(monkeypatch, tmp_path):
     """End-to-end pipeline with a .mid path prompt: fake pretty_midi load,
     real codec, real (tiny) model generate, fake pretty_midi output."""

@@ -63,6 +63,7 @@ def test_prefill_equals_uncached(setup):
     assert int(cache.sa.length[0]) == 4
 
 
+@pytest.mark.slow
 def test_decode_equals_uncached_growth_regime(setup):
     """Latents grow from 4 to max_latents=8 while the prefix stays fixed — the
     regime where cached and uncached forwards are mathematically identical
@@ -76,6 +77,7 @@ def test_decode_equals_uncached_growth_regime(setup):
         np.testing.assert_allclose(np.asarray(step[:, -1]), np.asarray(full[:, -1]), atol=1e-12)
 
 
+@pytest.mark.slow
 def test_decode_equals_uncached_left_padded(setup):
     model, params, x = setup
     pad = jnp.zeros((2, 8), bool).at[0, :3].set(True)
@@ -90,6 +92,7 @@ def test_decode_equals_uncached_left_padded(setup):
         np.testing.assert_allclose(np.asarray(step[:, -1]), np.asarray(full[:, -1]), atol=1e-12)
 
 
+@pytest.mark.slow
 def test_sliding_window_rolls_caches(setup):
     """Beyond max_seq_len the window slides: cache lengths stay pinned at capacity
     and decoding continues without error (no uncached ground truth exists here —
@@ -106,6 +109,7 @@ def test_sliding_window_rolls_caches(setup):
     np.testing.assert_array_equal(np.asarray(cache.ca.k[:, :-1]), old_k[:, 1:])  # rolled left
 
 
+@pytest.mark.slow
 def test_prefix_dropout_statistics():
     """Training-time prefix dropout keeps exactly prefix_len - int(prefix_len * p)
     positions (reference modules.py:814-821); with p=0.5 outputs must differ across
@@ -125,6 +129,7 @@ def test_prefix_dropout_statistics():
     np.testing.assert_array_equal(np.asarray(out3), np.asarray(out4))
 
 
+@pytest.mark.slow
 def test_prefill_rejects_nondeterministic():
     model = make_model(deterministic=False, cross_attention_dropout=0.5)
     rng = jax.random.PRNGKey(0)
@@ -135,6 +140,7 @@ def test_prefill_rejects_nondeterministic():
         model.apply(params, x, 4, cache, rngs={"dropout": rng}, method=CausalSequenceModel.prefill)
 
 
+@pytest.mark.slow
 def test_tied_embedding_head():
     """Output head must be tied to the input embedding: no separate vocab x channels
     output matrix in the param tree."""

@@ -43,7 +43,11 @@ def _loss_fn(model, x, labels):
     return f
 
 
-@pytest.mark.parametrize("axes", [{"pipe": 4}, {"data": 2, "pipe": 4}])
+@pytest.mark.slow  # value-level check subsumed by test_pipeline_gradients_match
+@pytest.mark.parametrize("axes", [
+    {"pipe": 4},
+    {"data": 2, "pipe": 4},
+])
 def test_pipeline_forward_matches(setup, axes):
     plain, piped, params, x = setup
     ref = plain.apply(params, x, prefix_len=16)
@@ -54,6 +58,7 @@ def test_pipeline_forward_matches(setup, axes):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("microbatches", [2, 8])
 def test_pipeline_microbatch_counts_match(setup, microbatches):
     plain, _, params, x = setup
@@ -79,6 +84,7 @@ def test_pipeline_gradients_match(setup):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_sharded_train_state_losses_match(setup):
     """End-to-end: layer params placed pipe-sharded by the partition rules,
     trained with the stock train step under a data x pipe mesh — per-step losses
@@ -112,6 +118,7 @@ def test_pipeline_sharded_train_state_losses_match(setup):
         assert abs(float(m["loss"]) - ref_losses[i]) < 1e-5
 
 
+@pytest.mark.slow
 def test_pipeline_dropout_trains(setup):
     """Stochastic paths (attention + residual dropout) run under the pipeline
     with per-layer/per-tick keys; loss stays finite."""
@@ -131,6 +138,7 @@ def test_pipeline_dropout_trains(setup):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_pipeline_decode_falls_back(setup):
     """Cached decode (single-token steps) bypasses the pipeline and must work
     under the mesh context."""

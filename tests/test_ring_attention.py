@@ -36,7 +36,11 @@ def qkv():
     return q, k, v
 
 
-@pytest.mark.parametrize("axes", [{"seq": 8}, {"seq": 4, "data": 2}, {"fsdp": 2, "seq": 4}])
+@pytest.mark.parametrize("axes", [
+    {"seq": 8},
+    pytest.param({"seq": 4, "data": 2}, marks=pytest.mark.slow),
+    pytest.param({"fsdp": 2, "seq": 4}, marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_single_device(qkv, axes, causal):
     q, k, v = qkv
@@ -83,7 +87,10 @@ def test_ring_gradients_with_pad_mask(qkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    True,
+    pytest.param(False, marks=pytest.mark.slow),
+])
 def test_ring_splash_blocks_interpret(causal):
     """Splash-kernel blocks inside the ring shard (interpret mode on CPU):
     fully-visible blocks run the fused kernel, the diagonal runs einsum; both
@@ -143,6 +150,7 @@ def test_ring_dropout_requires_rng(qkv):
         ring_attention(q, k, v, mesh, causal=True, dropout_rate=0.5)
 
 
+@pytest.mark.slow
 def test_mha_seq_axis_dropout_trains():
     """MultiHeadAttention with seq_axis + attention dropout (previously an
     explicit ValueError) runs forward and backward under a seq mesh."""

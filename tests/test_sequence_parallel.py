@@ -32,7 +32,11 @@ def setup():
     return plain, seqp, params, x
 
 
-@pytest.mark.parametrize("axes", [{"seq": 4}, {"data": 2, "seq": 4}])
+@pytest.mark.slow  # value-level check subsumed by test_sequence_parallel_train_gradients_match
+@pytest.mark.parametrize("axes", [
+    {"seq": 4},
+    {"data": 2, "seq": 4},
+])
 def test_sequence_parallel_forward_matches(setup, axes):
     plain, seqp, params, x = setup
     ref = plain.apply(params, x, prefix_len=16)
@@ -71,6 +75,7 @@ def test_sequence_parallel_requires_mesh(setup):
         seqp.apply(params, x, prefix_len=16)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_decode_falls_back(setup):
     """Cached decode ignores the seq axis (single-token steps are not
     sequence-parallel) and must still work under the mesh context."""

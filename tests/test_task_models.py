@@ -65,6 +65,7 @@ def test_mlm_untied_head():
     assert len(vocab_kernels) == 1  # untied TokenOutputAdapter Dense
 
 
+@pytest.mark.slow
 def test_mlm_mask_fill_learns():
     """A tiny MLM can learn to copy unmasked positions / recover a fixed token."""
     import optax
@@ -110,6 +111,7 @@ def test_text_classifier_forward():
     assert model.apply(params, x).shape == (3, 2)
 
 
+@pytest.mark.slow
 def test_clm_and_sam_are_causal_sequence_models():
     for cls, cfg_cls in [(CausalLanguageModel, CausalLanguageModelConfig), (SymbolicAudioModel, SymbolicAudioModelConfig)]:
         cfg = cfg_cls(vocab_size=50, max_seq_len=12, max_latents=6, num_channels=16, num_heads=2,
@@ -143,6 +145,7 @@ def flow_config(h=16, w=24):
     )
 
 
+@pytest.mark.slow  # forward path subsumed by test_optical_flow_pipeline_end_to_end
 def test_optical_flow_dense_decoding():
     model = OpticalFlow(config=flow_config())
     x = jnp.zeros((2, 2, 3, 16, 24))  # (B, frames, C, H, W)
@@ -151,6 +154,7 @@ def test_optical_flow_dense_decoding():
     assert flow.shape == (2, 16, 24, 2)  # per-pixel 2-channel flow field
 
 
+@pytest.mark.slow
 def test_optical_flow_rescale():
     model = OpticalFlow(config=flow_config())
     x = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 3, 16, 24))

@@ -72,6 +72,7 @@ def tiny_image_classifier():
     return ImageClassifier(config=cfg)
 
 
+@pytest.mark.slow
 def test_image_classifier_learns_toy_task():
     model = tiny_image_classifier()
     rng = jax.random.PRNGKey(0)
@@ -98,9 +99,11 @@ def test_image_shape_validation():
 
 
 def lm_setup(batch=8, seq=16):
+    # 1 SA layer: the scan structure (and everything these trainer-level tests
+    # assert) is layer-count-independent, and compile time is the suite's cost
     cfg = CausalSequenceModelConfig(
         vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
-        num_self_attention_layers=2, cross_attention_dropout=0.5,
+        num_self_attention_layers=1, cross_attention_dropout=0.5,
     )
     model = CausalSequenceModel(config=cfg, deterministic=False)
     rng = jax.random.PRNGKey(0)
@@ -114,6 +117,7 @@ def lm_setup(batch=8, seq=16):
     return model, cfg, params, batch_data
 
 
+@pytest.mark.slow
 def test_causal_lm_train_step_runs():
     model, cfg, params, batch = lm_setup()
     tx = build_optimizer(cosine_with_warmup(1e-3, 100, 10), max_grad_norm=1.0)
@@ -142,9 +146,11 @@ def test_optimizer_freeze_filter():
 
 
 @pytest.mark.parametrize("axes,mode", [
-    ({"data": 8}, "dp"),
-    ({"data": 2, "fsdp": 4}, "fsdp"),
-    ({"fsdp": 2, "tensor": 4}, "fsdp"),
+    # default tier keeps the 3-axis variant (exercises data+fsdp+tensor in one
+    # program); the single-purpose meshes are slow-tier redundancy
+    pytest.param({"data": 8}, "dp", marks=pytest.mark.slow),
+    pytest.param({"data": 2, "fsdp": 4}, "fsdp", marks=pytest.mark.slow),
+    pytest.param({"fsdp": 2, "tensor": 4}, "fsdp", marks=pytest.mark.slow),
     ({"data": 2, "fsdp": 2, "tensor": 2}, "fsdp"),
 ])
 def test_sharded_training_matches_single_device(axes, mode):
@@ -253,7 +259,7 @@ def test_gradient_accumulation():
     the effective LR and diverge)."""
     cfg = CausalSequenceModelConfig(
         vocab_size=32, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
-        num_self_attention_layers=2, cross_attention_dropout=0.0,  # no dropout: identical grads
+        num_self_attention_layers=1, cross_attention_dropout=0.0,  # no dropout: identical grads
     )
     model = CausalSequenceModel(config=cfg, deterministic=True)
     rng = jax.random.PRNGKey(0)
@@ -276,6 +282,7 @@ def test_gradient_accumulation():
     np.testing.assert_allclose(np.asarray(path(s2.params)), np.asarray(path(s1.params)), atol=1e-7)
 
 
+@pytest.mark.slow
 def test_remat_policy_preserves_training_numerics():
     """activation_checkpointing with a dots-saveable policy must be a pure
     memory/FLOPs tradeoff: losses and gradients identical to no-remat."""
@@ -304,6 +311,7 @@ def test_remat_policy_preserves_training_numerics():
     np.testing.assert_allclose(losses(True, "dots_with_no_batch_dims_saveable"), base, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_scan_unroll_preserves_training_numerics():
     """Unrolling the layer scan is a pure compile-time tradeoff."""
     def losses(unroll):
