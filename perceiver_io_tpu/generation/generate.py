@@ -43,6 +43,13 @@ class GenerationConfig:
     penalty_alpha: Optional[float] = None  # with top_k > 1: contrastive search
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
+    # Greedy chunked decode (Jacobi self-speculation): draft decode_chunk tokens
+    # per iteration from the previous iteration's own greedy continuations,
+    # verify them in ONE multi-query forward (the fused decode kernel path for
+    # chunks <= 8), and commit the longest correct prefix (always >= 1). Output
+    # is exactly the token-by-token greedy sequence; only the iteration count
+    # changes. Requires greedy sampling with no EOS (see generate()).
+    decode_chunk: int = 1
 
 
 def _validate(model, seq_len: int, num_latents: int) -> int:
@@ -102,6 +109,80 @@ def _generate_single(model, params, input_ids, pad_mask, rng, *, prefix_len: int
     rngs = jax.random.split(rng, config.max_new_tokens)
     (_, _, _), tokens = jax.lax.scan(body, (cache, next_logits, finished0), rngs)
     return jnp.concatenate([input_ids, tokens.T], axis=1)
+
+
+@partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
+def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: int, config: GenerationConfig):
+    """Greedy decode emitting up to ``decode_chunk`` tokens per iteration.
+
+    Jacobi self-speculation: each iteration drafts a block [known-next-token,
+    guesses...] (the guesses are the previous iteration's own greedy
+    continuations), scores all of it in ONE ``decode_block`` forward, and
+    commits the longest prefix whose drafts match the greedy chain — at least
+    one token per iteration, so the loop always terminates, and every committed
+    token equals what token-by-token greedy would emit. Rejected drafts are
+    un-appended with ``cache.rewind`` (exact under decode_block's no-roll
+    contract).
+
+    The chunked phase is statically sized to the no-roll region of both caches
+    (``k_chunk``); the remaining tokens (where the sliding window must roll)
+    decode token-by-token, identically to ``_generate_single``. Commit length
+    is the batch MINIMUM acceptance (the caches share one scalar length), so
+    per-example speedup is bounded by the slowest example in the batch.
+    """
+    b, seq_len = input_ids.shape
+    n = config.decode_chunk
+    max_new = config.max_new_tokens
+    # static no-roll budget: the chunked phase may append at most this many
+    # tokens (cross-attention cache headroom AND self-attention/latent headroom)
+    k_chunk = min(max_new, model.max_seq_len - seq_len, model.max_latents - (seq_len - prefix_len))
+
+    cache = model.init_cache(batch_size=b, dtype=_cache_dtype(model))
+    logits, cache = model.apply(params, input_ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill)
+    next_logits = logits[:, -1]
+
+    out_buf = jnp.zeros((b, max_new + n), jnp.int32)
+    emitted0 = jnp.zeros((), jnp.int32)
+    guesses0 = jnp.zeros((b, n - 1), jnp.int32)
+
+    def chunk_cond(carry):
+        return carry[0] + n <= k_chunk  # a full chunk still fits the no-roll budget
+
+    def chunk_body(carry):
+        emitted, cache, next_logits, guesses, out_buf = carry
+        tok0 = jnp.argmax(next_logits, axis=-1).astype(jnp.int32)  # always-correct head token
+        cand = jnp.concatenate([tok0[:, None], guesses], axis=1)  # (B, n)
+        logits_blk, cache = model.apply(params, cand, cache, method=type(model).decode_block)
+        y = jnp.argmax(logits_blk, axis=-1).astype(jnp.int32)  # greedy continuation of each draft
+        ok = cand[:, 1:] == y[:, :-1]  # draft i is correct iff it IS the continuation of draft i-1
+        acc = 1 + jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)  # (B,)
+        m = jnp.min(acc).astype(emitted.dtype)
+        cache = cache.rewind(n - m)
+        # write the whole block; columns beyond m are stale and get overwritten
+        # by the next iteration's write at emitted + m
+        out_buf = jax.lax.dynamic_update_slice(out_buf, cand, (jnp.zeros((), emitted.dtype), emitted))
+        next_logits = jax.lax.dynamic_index_in_dim(logits_blk, m - 1, axis=1, keepdims=False)
+        # refreshed guesses: the just-computed continuations shifted to the new
+        # frontier (clamped gather; trailing slots just repeat the last one)
+        guesses = jnp.take(y, jnp.minimum(m + jnp.arange(n - 1), n - 1), axis=1)
+        return emitted + m, cache, next_logits, guesses, out_buf
+
+    emitted, cache, next_logits, _, out_buf = jax.lax.while_loop(
+        chunk_cond, chunk_body, (emitted0, cache, next_logits, guesses0, out_buf)
+    )
+
+    def tail_cond(carry):
+        return carry[0] < max_new
+
+    def tail_body(carry):
+        emitted, cache, next_logits, out_buf = carry
+        tok = jnp.argmax(next_logits, axis=-1).astype(jnp.int32)
+        logits_t, cache = model.apply(params, tok[:, None], cache, method=type(model).decode_step)
+        out_buf = jax.lax.dynamic_update_slice(out_buf, tok[:, None], (jnp.zeros((), emitted.dtype), emitted))
+        return emitted + 1, cache, logits_t[:, -1], out_buf
+
+    _, _, _, out_buf = jax.lax.while_loop(tail_cond, tail_body, (emitted, cache, next_logits, out_buf))
+    return jnp.concatenate([input_ids, out_buf[:, :max_new].astype(input_ids.dtype)], axis=1)
 
 
 @partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
@@ -260,6 +341,19 @@ def generate(
     prefix_len = _validate(model, input_ids.shape[1], num_latents)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if config.decode_chunk > 1:
+        if (
+            config.do_sample
+            or config.num_beams > 1
+            or config.eos_token_id is not None
+            or (config.penalty_alpha is not None and config.penalty_alpha > 0)
+        ):
+            raise ValueError(
+                "decode_chunk > 1 (chunked greedy decode) requires do_sample=False, "
+                "num_beams=1, penalty_alpha=None and eos_token_id=None — draft "
+                "verification is exact only for the deterministic greedy chain"
+            )
+        return _generate_chunked(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
     if config.penalty_alpha is not None and config.penalty_alpha > 0:
         if not config.top_k or config.top_k < 2:
             raise ValueError("contrastive search requires top_k >= 2 with penalty_alpha")

@@ -68,6 +68,20 @@ class PerceiverARCache(flax.struct.PyTreeNode):
     def seq_len(self) -> jax.Array:
         return self.ca.length
 
+    def rewind(self, k: jax.Array) -> "PerceiverARCache":
+        """Drop the ``k`` most recently appended tokens by rewinding the cache
+        lengths (``k`` may be traced). Valid ONLY when none of those appends
+        rolled the buffers (the no-roll contract of ``decode_block``): the
+        rejected rows then sit beyond the rewound length, invisible behind the
+        causal/validity bounds, and the next append overwrites them. This is
+        what makes speculative/chunked decode verification O(1): committing m
+        of n drafted tokens is a scalar length update, not a buffer edit."""
+        k = jnp.asarray(k, jnp.int32)
+        return self.replace(
+            ca=self.ca.replace(length=jnp.maximum(self.ca.length - k, 0)),
+            sa=self.sa.replace(length=jnp.maximum(self.sa.length - k, 0)),
+        )
+
 
 def _make_ar_cache(
     batch_size: int, max_seq_len: int, max_latents: int, num_layers: int, num_channels: int, dtype=jnp.float32
@@ -298,26 +312,45 @@ class PerceiverAR(nn.Module):
         new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=shift)
         return x_latent, new_cache
 
-    def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
-        """One decode step with token(s) ``x`` (B, 1). The new token joins the
-        latents; full caches roll their oldest entry out (= the reference's sliding
-        window where the oldest latent is absorbed into the prefix)."""
-        b = x.shape[0]
-        assert x.shape[1] == 1, "decode_step processes one token at a time"
+    def decode_block(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
+        """Decode ``n`` tokens ``x`` (B, n) in one forward: every token joins the
+        latents and each attends causally to the cache plus its block
+        predecessors (the cached-attention per-query bounds,
+        ops/attention.py:310-314 — on TPU the fused multi-query decode kernel,
+        ops/decode_kernel.py, for n <= 8).
+
+        ``n == 1`` is the general sliding-window step: full caches roll their
+        oldest entry out (= the reference's window policy where the oldest
+        latent is absorbed into the prefix, core/huggingface.py:89-156).
+
+        ``n > 1`` is the speculative/chunked-verification step and carries a
+        NO-ROLL CONTRACT: the caller must guarantee ``length + n <= capacity``
+        for both caches (generation/generate.py sizes its chunked phase
+        statically so this holds). Under that contract the block append never
+        evicts, so (a) every block token's attention set is exactly what n
+        sequential steps would see, and (b) ``cache.rewind`` can un-append
+        rejected draft tokens exactly."""
+        b, n = x.shape
         ca_cap = cache.ca.capacity
         sa_cap = cache.sa.k.shape[2]
         rot = self._rotated_dim()
 
-        n_after = jnp.minimum(cache.ca.length + 1, ca_cap)  # window length after append
-        q_pos = jnp.maximum(n_after - 1 - cache.shift, 0)  # (b, 1)
+        n_after = jnp.minimum(cache.ca.length + n, ca_cap)  # window length after append
+        # token i's absolute position; saturation only ever engages for n == 1
+        # (the no-roll contract keeps n > 1 strictly below capacity)
+        q_pos = jnp.maximum(n_after - n + jnp.arange(n)[None, :] - cache.shift, 0)  # (b, n)
 
         x_emb, frq_q = self.input_adapter(x, abs_pos=q_pos)
 
-        # Roll the pad-slot mask in lockstep with the cross-attention cache append.
-        full = cache.ca.length >= ca_cap
-        pad_slots = jnp.where(full, jnp.roll(cache.pad_slots, -1, axis=1), cache.pad_slots)
-        write_pos = jnp.minimum(cache.ca.length, ca_cap - 1)
-        pad_slots = jax.lax.dynamic_update_slice_in_dim(pad_slots, jnp.zeros((b, 1), bool), write_pos, axis=1)
+        if n == 1:
+            # Roll the pad-slot mask in lockstep with the cross-attention cache append.
+            full = cache.ca.length >= ca_cap
+            pad_slots = jnp.where(full, jnp.roll(cache.pad_slots, -1, axis=1), cache.pad_slots)
+            write_pos = jnp.minimum(cache.ca.length, ca_cap - 1)
+        else:
+            pad_slots = cache.pad_slots
+            write_pos = cache.ca.length  # fits by the no-roll contract
+        pad_slots = jax.lax.dynamic_update_slice_in_dim(pad_slots, jnp.zeros((b, n), bool), write_pos, axis=1)
 
         slot_pos = jnp.maximum(jnp.arange(ca_cap)[None, :] - cache.shift, 0)
         rope_k_ca = frequency_position_encoding(slot_pos, rot)
@@ -328,7 +361,7 @@ class PerceiverAR(nn.Module):
 
         # Self-attention cache slot j holds the (j+1)-th oldest latent; its sequence
         # position is n_after - sa_len_after + j.
-        sa_len_after = jnp.minimum(cache.sa.length[0] + 1, sa_cap)
+        sa_len_after = jnp.minimum(cache.sa.length[0] + n, sa_cap)
         sa_slot_pos = n_after - sa_len_after + jnp.arange(sa_cap)[None, :]
         sa_slot_pos = jnp.maximum(sa_slot_pos - cache.shift, 0)
         rope_k_sa = frequency_position_encoding(sa_slot_pos, rot)
@@ -338,6 +371,11 @@ class PerceiverAR(nn.Module):
         )
         new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=cache.shift)
         return x_latent, new_cache
+
+    def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
+        """One decode step with token(s) ``x`` (B, 1); see ``decode_block``."""
+        assert x.shape[1] == 1, "decode_step processes one token at a time; use decode_block for chunks"
+        return self.decode_block(x, cache)
 
 
 class CausalSequenceModel(nn.Module):
@@ -455,3 +493,10 @@ class CausalSequenceModel(nn.Module):
     def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
         logits, _, cache = self.decode_step_with_hidden(x, cache)
         return logits, cache
+
+    def decode_block(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
+        """Decode ``n`` tokens at once (chunked/speculative verification); see
+        ``PerceiverAR.decode_block`` for the n > 1 no-roll contract. Returns
+        logits (B, n, vocab) — one next-token distribution per block position."""
+        hidden, cache = self.ar.decode_block(x, cache)
+        return self._head(hidden), cache
