@@ -28,11 +28,12 @@ Other tasks:
                            against a fixed A100-equivalent per-chip target
                            derived in ``_OF_TARGET_FPS_PER_CHIP`` below.
   ``--task decode``        cached autoregressive decode (batch 8, 2048-token
-                           prompt, 512 new tokens) through ``generate()``.
-                           vs_baseline is the fused Pallas cached-decode
-                           kernel's speedup over the same loop with the kernel
-                           disabled (PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL) —
-                           the artifact record of ops/decode_kernel.py's win.
+                           prompt, 512 new tokens) through ``generate()`` with
+                           the full decode stack (chunked greedy decode via
+                           the multi-query fused kernel). vs_baseline is the
+                           CHUNKING win over the single-token loop (the r01
+                           methodology); the fused-kernel on/off ratio is the
+                           record's ``kernel_speedup`` field.
 """
 
 from __future__ import annotations

@@ -8,9 +8,11 @@ itself adapted from jason9693/midi-neural-processor): the event vocabulary is
   - velocity  32 4-step bins    -> token 356..387
 388 event tokens; the data module adds PAD=388 for a model vocab of 389.
 
-This implementation is dependency-free at its core: it operates on plain
-``Note``/``ControlChange`` records. ``pretty_midi`` is only needed for reading /
-writing actual .mid files and is imported lazily (it is not part of this image).
+This implementation is dependency-free INCLUDING file IO: it operates on plain
+``Note``/``ControlChange`` records, and .mid files are read/written by the
+native Standard-MIDI-File codec in ``smf.py``. ``pretty_midi``, when installed,
+is accepted as an input object and serves as an optional cross-check
+(tests/test_real_binaries.py); nothing requires it.
 Sustain-pedal (CC64) handling matches the reference: notes sounding while the
 pedal is down are extended until the next onset of the same pitch or the pedal
 release, whichever comes first.
@@ -119,61 +121,68 @@ def encode_notes(notes: Sequence[Note], control_changes: Sequence[ControlChange]
 
 def decode_notes(tokens: Sequence[int]) -> List[Note]:
     """Event token sequence -> notes (zero-length notes are dropped; unmatched
-    note_offs are ignored, matching the reference's tolerant decoding)."""
+    note_offs are ignored, matching the reference's tolerant decoding). Notes
+    come back in onset order with ties broken by NOTE_ON token order — chords
+    keep their event order, so encode_notes(decode_notes(t)) == t."""
     timeline = 0.0
     velocity = 0
-    open_notes: Dict[int, Tuple[float, int]] = {}
-    notes: List[Note] = []
+    seq = 0
+    open_notes: Dict[int, Tuple[float, int, int]] = {}  # pitch -> (start, velocity, onset_seq)
+    staged: List[Tuple[float, int, Note]] = []
     for token in tokens:
         token = int(token)
         if token < NOTE_OFF_OFFSET:
-            open_notes[token] = (timeline, velocity)
+            open_notes[token] = (timeline, velocity, seq)
+            seq += 1
         elif token < TIME_SHIFT_OFFSET:
             pitch = token - NOTE_OFF_OFFSET
             if pitch in open_notes:
-                start, vel = open_notes.pop(pitch)
+                start, vel, s = open_notes.pop(pitch)
                 if timeline > start:
-                    notes.append(Note(pitch=pitch, velocity=vel, start=start, end=timeline))
+                    staged.append((start, s, Note(pitch=pitch, velocity=vel, start=start, end=timeline)))
         elif token < VELOCITY_OFFSET:
             timeline += (token - TIME_SHIFT_OFFSET + 1) / 100.0
         elif token < NUM_EVENTS:
             velocity = (token - VELOCITY_OFFSET) * 4
-    notes.sort(key=lambda n: n.start)
-    return notes
+    staged.sort(key=lambda x: (x[0], x[1]))
+    return [n for _, _, n in staged]
 
 
-# ------------------------------------------------------------- pretty_midi IO
+# -------------------------------------------------------------------- file IO
+# Native Standard-MIDI-File parse/serialize (data/audio/smf.py) — zero optional
+# dependencies. pretty_midi objects are still ACCEPTED (duck-typed via their
+# .instruments attribute) so code holding one can pass it straight in, and the
+# real-binaries test tier cross-checks the native writer against pretty_midi
+# when that package happens to be installed.
 
 
 def encode_midi(midi) -> List[int]:
-    """pretty_midi.PrettyMIDI -> tokens."""
-    notes: List[Note] = []
-    ccs: List[ControlChange] = []
-    for inst in midi.instruments:
-        notes.extend(Note(n.pitch, n.velocity, n.start, n.end) for n in inst.notes)
-        ccs.extend(ControlChange(c.number, c.value, c.time) for c in inst.control_changes)
-    return encode_notes(notes, ccs)
+    """A MIDI document -> tokens. Accepts an ``smf.SMF`` (native reader output)
+    or any pretty_midi-shaped object (``.instruments`` with notes/CCs)."""
+    if hasattr(midi, "instruments"):  # pretty_midi.PrettyMIDI (optional dep)
+        notes = [Note(n.pitch, n.velocity, n.start, n.end) for inst in midi.instruments for n in inst.notes]
+        ccs = [ControlChange(c.number, c.value, c.time) for inst in midi.instruments for c in inst.control_changes]
+        return encode_notes(notes, ccs)
+    return encode_notes(midi.notes, midi.control_changes)
 
 
 def decode_midi(tokens: Sequence[int], file_path: Optional[str] = None):
-    """Tokens -> pretty_midi.PrettyMIDI (requires pretty_midi)."""
-    import pretty_midi
+    """Tokens -> ``smf.SMF`` document (dependency-free); writes a format-0
+    .mid file when ``file_path`` is given."""
+    from perceiver_io_tpu.data.audio.smf import SMF
 
-    notes = decode_notes(tokens)
-    mid = pretty_midi.PrettyMIDI()
-    instrument = pretty_midi.Instrument(1, False, "perceiver-io-tpu")
-    instrument.notes = [pretty_midi.Note(n.velocity, n.pitch, n.start, n.end) for n in notes]
-    mid.instruments.append(instrument)
+    doc = SMF(notes=decode_notes(tokens))
     if file_path is not None:
-        mid.write(file_path)
-    return mid
+        doc.write(file_path)
+    return doc
 
 
 def encode_midi_file(path: str) -> Optional[np.ndarray]:
     try:
-        import pretty_midi
+        from perceiver_io_tpu.data.audio.smf import read_smf
 
-        return np.asarray(encode_midi(pretty_midi.PrettyMIDI(str(path))), dtype=np.int16)
+        doc = read_smf(str(path))
+        return np.asarray(encode_notes(doc.notes, doc.control_changes), dtype=np.int16)
     except Exception as e:  # noqa: BLE001 — skip unreadable files like the reference
         print(f"Error encoding midi file [{path}]: {e}")
         return None

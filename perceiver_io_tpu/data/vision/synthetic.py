@@ -43,18 +43,24 @@ _GLYPHS = {d: np.array([[c == "1" for c in row] for row in rows], np.float32)
            for d, rows in _GLYPH_ROWS.items()}
 
 
-def render_digit(rng: np.random.Generator, digit: int, size: int = 28) -> np.ndarray:
-    """One (size, size) uint8 image of ``digit`` under a random affine warp."""
+def render_digit(rng: np.random.Generator, digit: int, size: int = 28, hard: bool = False) -> np.ndarray:
+    """One (size, size) uint8 image of ``digit`` under a random affine warp.
+
+    ``hard=True`` is the difficulty-calibration tier: much heavier warps,
+    random rectangular occlusion, a distractor stroke, lower contrast and 3x
+    the sensor noise — built so neither the Perceiver nor a linear probe
+    saturates, giving the easy tier's accuracy a denominator."""
     from scipy import ndimage
 
     glyph = _GLYPHS[digit]
     # upscale the 7x5 glyph to a ~20x14 stroke box (nearest, then smoothed)
     up = np.kron(glyph, np.ones((3, 3), np.float32))  # 21x15
 
-    theta = rng.uniform(-0.30, 0.30)  # radians, ~±17°
-    shear = rng.uniform(-0.25, 0.25)
-    sx = rng.uniform(0.80, 1.25)
-    sy = rng.uniform(0.80, 1.25)
+    warp = 1.8 if hard else 1.0
+    theta = rng.uniform(-0.30, 0.30) * warp  # radians; hard: ~±31°
+    shear = rng.uniform(-0.25, 0.25) * warp
+    sx = rng.uniform(0.80, 1.25) ** warp
+    sy = rng.uniform(0.80, 1.25) ** warp
     c, s = np.cos(theta), np.sin(theta)
     # output->input coordinate map for ndimage.affine_transform
     mat = np.array([[c, -s], [s, c]], np.float32) @ np.array([[1.0, shear], [0.0, 1.0]], np.float32)
@@ -62,21 +68,37 @@ def render_digit(rng: np.random.Generator, digit: int, size: int = 28) -> np.nda
 
     center_in = np.array(up.shape, np.float32) / 2 - 0.5
     center_out = np.array([size, size], np.float32) / 2 - 0.5
-    center_out += rng.uniform(-3.0, 3.0, size=2)  # translation jitter
+    center_out += rng.uniform(-3.0, 3.0, size=2) * (1.6 if hard else 1.0)  # translation jitter
     offset = center_in - mat @ center_out
 
     img = ndimage.affine_transform(up, mat, offset=offset, output_shape=(size, size), order=1)
     img = ndimage.gaussian_filter(img, sigma=rng.uniform(0.5, 1.0))  # stroke thickness
-    img = np.clip(img * rng.uniform(1.8, 3.0), 0.0, 1.0)  # contrast back up
-    img = img + rng.normal(0.0, 0.04, img.shape)  # sensor noise
+    if hard:
+        # occlusion: a rectangle of the stroke region wiped out
+        oh, ow = rng.integers(4, 9), rng.integers(4, 9)
+        oy, ox = rng.integers(0, size - oh), rng.integers(0, size - ow)
+        img[oy : oy + oh, ox : ox + ow] = 0.0
+        # distractor stroke: a random bright line segment
+        y0, x0 = rng.integers(0, size, 2)
+        ln = int(rng.integers(6, 13))
+        dy, dx = rng.uniform(-1, 1, 2)
+        norm = max(np.hypot(dy, dx), 1e-6)
+        ys = np.clip(y0 + np.arange(ln) * dy / norm, 0, size - 1).astype(int)
+        xs = np.clip(x0 + np.arange(ln) * dx / norm, 0, size - 1).astype(int)
+        img[ys, xs] = np.maximum(img[ys, xs], rng.uniform(0.6, 1.0))
+        img = np.clip(img * rng.uniform(1.2, 2.0), 0.0, 1.0)  # weaker contrast recovery
+        img = img + rng.normal(0.0, 0.12, img.shape)  # 3x sensor noise
+    else:
+        img = np.clip(img * rng.uniform(1.8, 3.0), 0.0, 1.0)  # contrast back up
+        img = img + rng.normal(0.0, 0.04, img.shape)  # sensor noise
     return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
 
 
-def make_glyph_digits(n: int, seed: int, size: int = 28):
+def make_glyph_digits(n: int, seed: int, size: int = 28, hard: bool = False):
     """(images (n, size, size) uint8, labels (n,) int64), deterministic in seed."""
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, 10, size=n).astype(np.int64)
-    images = np.stack([render_digit(rng, int(d), size) for d in labels])
+    images = np.stack([render_digit(rng, int(d), size, hard=hard) for d in labels])
     return images, labels
 
 
@@ -104,13 +126,13 @@ class SyntheticDigitsDataModule(MNISTDataModule):
     """Drop-in MNISTDataModule subclass that swaps the HF download for local
     sources; transforms, collation and loaders are inherited unchanged."""
 
-    source: str = "glyphs"  # "glyphs" | "sklearn_digits"
+    source: str = "glyphs"  # "glyphs" | "glyphs_hard" | "sklearn_digits"
     n_train: int = 20000  # glyphs only
     n_val: int = 2000
 
     @property
     def image_shape(self):
-        base = 28 if self.source == "glyphs" else 8
+        base = 8 if self.source == "sklearn_digits" else 28
         side = self.random_crop or base
         return (side, side, 1) if self.channels_last else (1, side, side)
 
@@ -118,12 +140,22 @@ class SyntheticDigitsDataModule(MNISTDataModule):
         pass  # nothing to download
 
     def _load_splits(self):
-        if self.source == "glyphs":
-            return (make_glyph_digits(self.n_train, seed=self.seed),
-                    make_glyph_digits(self.n_val, seed=self.seed + 10_000))
-        if self.source == "sklearn_digits":
-            return load_sklearn_digits()
-        raise ValueError(f"unknown source {self.source!r}: expected glyphs | sklearn_digits")
+        # memoized: rendering 22k warped glyphs through scipy is the expensive
+        # part, and callers (setup + the convergence linear-probe baseline)
+        # legitimately both want the same deterministic arrays
+        cached = getattr(self, "_splits_cache", None)
+        if cached is not None:
+            return cached
+        if self.source in ("glyphs", "glyphs_hard"):
+            hard = self.source == "glyphs_hard"
+            splits = (make_glyph_digits(self.n_train, seed=self.seed, hard=hard),
+                      make_glyph_digits(self.n_val, seed=self.seed + 10_000, hard=hard))
+        elif self.source == "sklearn_digits":
+            splits = load_sklearn_digits()
+        else:
+            raise ValueError(f"unknown source {self.source!r}: expected glyphs | glyphs_hard | sklearn_digits")
+        self._splits_cache = splits
+        return splits
 
     def setup(self) -> None:
         (tr_images, tr_labels), (va_images, va_labels) = self._load_splits()

@@ -119,12 +119,13 @@ class SymbolicAudioPipeline:
         return_notes: bool = False,
         **generation_kwargs,
     ):
-        """``midi`` may be a .mid path, a pretty_midi.PrettyMIDI, a sequence of
-        ``midi_processor.Note`` records, or a sequence of event-token ints; only
-        the first two need the optional pretty_midi dependency. With
-        ``return_notes=True`` the return value is always plain ``Note`` records
-        (pretty_midi required only if an output/render path is also given);
-        numpy token arrays (e.g. ``encode_midi_file`` output) are accepted."""
+        """``midi`` may be a .mid path (parsed by the native SMF codec), an
+        ``smf.SMF`` or pretty_midi.PrettyMIDI document, a sequence of
+        ``midi_processor.Note`` records, or a sequence of event-token ints —
+        no optional dependencies anywhere on this path. With
+        ``return_notes=True`` the return value is plain ``Note`` records;
+        otherwise an ``smf.SMF`` document. numpy token arrays (e.g.
+        ``encode_midi_file`` output) are accepted."""
         from perceiver_io_tpu.data.audio.midi_processor import (
             Note,
             decode_midi,
@@ -134,9 +135,9 @@ class SymbolicAudioPipeline:
         )
 
         if isinstance(midi, (str, Path)):
-            import pretty_midi
+            from perceiver_io_tpu.data.audio.smf import read_smf
 
-            midi = pretty_midi.PrettyMIDI(str(midi))
+            midi = read_smf(str(midi))  # native SMF parse; no optional deps
         if isinstance(midi, np.ndarray):
             midi = midi.tolist()  # e.g. encode_midi_file output
         if isinstance(midi, (list, tuple)):

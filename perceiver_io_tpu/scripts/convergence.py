@@ -7,12 +7,18 @@ Tasks (each writes convergence/<task>.json with the full eval history):
   digits_glyphs    the MNIST recipe (exact scripts/vision/image_classifier.py
                    architecture, 907K params) on generated 28x28 digits;
                    target: val_acc >= 0.98 (the reference's MNIST bar).
+  digits_glyphs_hard  same recipe on the occlusion/heavy-warp/distractor tier —
+                   the difficulty-calibration family: no bar, reported against
+                   a linear-probe baseline (every digits task records one).
   digits_sklearn   a smaller Perceiver IO on the bundled real scikit-learn
                    digits (1,797 8x8 scans); target: val_acc >= 0.98.
   clm_markov       Perceiver AR byte CLM on an order-2 Markov corpus whose
                    conditional entropy is computed analytically — the one
                    corpus with an EXACT loss target; met when val CE is within
                    0.05 nats of the floor.
+  clm_markov_sharded  the clm_markov recipe through the PRODUCTION execution
+                   path: virtual data(2) x fsdp(4) mesh, bf16 compute,
+                   dots-saveable remat, fused qkv — same analytic floor.
   clm_pysrc        Perceiver AR byte CLM on the installed site-packages'
                    python source (real text, no analytic floor): the curve +
                    final bits/byte are recorded.
@@ -39,25 +45,45 @@ import numpy as np
 
 
 def _fit(model, eval_model, data, steps, lr, make_train_step, make_eval_step,
-         monitor, monitor_mode, init_fn, warmup_cap=500):
+         monitor, monitor_mode, init_fn, warmup_cap=500, mesh_axes=None):
     import optax
 
     from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
     from perceiver_io_tpu.training.trainer import TrainState
 
-    params = jax.jit(init_fn)()
-    n_params = sum(p.size for p in jax.tree.leaves(params))
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adamw(optax.warmup_cosine_decay_schedule(0.0, lr, min(warmup_cap, steps // 4), steps)))
-    state = TrainState.create(params, tx)
+    if mesh_axes:
+        # production path: params + moments initialize directly sharded on the
+        # mesh (jitted factory with out_shardings — no host-resident full copy)
+        state = lambda: TrainState.create(init_fn(), tx)
+        shapes = jax.eval_shape(init_fn)
+        n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    else:
+        params = jax.jit(init_fn)()
+        n_params = sum(p.size for p in jax.tree.leaves(params))
+        state = TrainState.create(params, tx)
     eval_every = max(steps // 12, 1)
     trainer = Trainer(TrainerConfig(
         max_steps=steps, eval_every=eval_every, log_every=eval_every,
-        monitor=monitor, monitor_mode=monitor_mode,
+        monitor=monitor, monitor_mode=monitor_mode, mesh_axes=mesh_axes or None,
     ))
     trainer.fit(state, make_train_step(model, tx), data.train_dataloader,
                 eval_step=make_eval_step(eval_model), eval_loader_fn=data.val_dataloader)
     return trainer.history, n_params
+
+
+def _linear_probe_acc(splits, cap: int = 10_000) -> float:
+    """Multinomial logistic regression on raw pixels — the trivial baseline
+    that calibrates how hard a digit tier actually is (VERDICT r3 weak #3: a
+    1.0 on easy data over-reads without a denominator)."""
+    from sklearn.linear_model import LogisticRegression
+
+    (tr_x, tr_y), (va_x, va_y) = splits
+    tr = tr_x[:cap].reshape(min(len(tr_x), cap), -1).astype(np.float32) / 255.0
+    va = va_x.reshape(len(va_x), -1).astype(np.float32) / 255.0
+    clf = LogisticRegression(max_iter=300).fit(tr, tr_y[:cap])
+    return float(clf.score(va, va_y))
 
 
 def run_digits(source: str, steps: int, task_name: str = ""):
@@ -70,9 +96,10 @@ def run_digits(source: str, steps: int, task_name: str = ""):
     )
     from perceiver_io_tpu.training.trainer import make_classifier_eval_step, make_classifier_train_step
 
-    if source == "glyphs":
-        data = SyntheticDigitsDataModule(source="glyphs", n_train=20_000, n_val=2_000, batch_size=128)
+    if source in ("glyphs", "glyphs_hard"):
+        data = SyntheticDigitsDataModule(source=source, n_train=20_000, n_val=2_000, batch_size=128)
         # the exact MNIST recipe architecture (scripts/vision/image_classifier.py)
+        # for BOTH tiers, so easy-vs-hard accuracy differences are data-only
         enc_kw = dict(num_frequency_bands=32, num_cross_attention_layers=2, num_cross_attention_heads=1,
                       num_self_attention_blocks=3, num_self_attention_layers_per_block=3,
                       num_self_attention_heads=8, first_cross_attention_layer_shared=False,
@@ -85,6 +112,7 @@ def run_digits(source: str, steps: int, task_name: str = ""):
                       num_self_attention_heads=4, dropout=0.1, init_scale=0.1)
         num_latents, num_latent_channels = 16, 64
     data.setup()
+    baseline_acc = _linear_probe_acc(data._load_splits())
 
     encoder = ImageEncoderConfig(image_shape=data.image_shape, **enc_kw)
     decoder = ClassificationDecoderConfig(num_classes=10, num_output_query_channels=128,
@@ -102,18 +130,39 @@ def run_digits(source: str, steps: int, task_name: str = ""):
         monitor="acc", monitor_mode="max", init_fn=lambda: model.init(rngs, sample),
     )
     accs = [h["val_acc"] for h in history if "val_acc" in h]
+    achieved = max(accs) if accs else None
+    if source == "glyphs_hard":
+        # difficulty-calibration tier: no reference bar; MET means the model
+        # beats the trivial baseline — the margin is the deliverable
+        target = {"metric": "val_acc", "value": None,
+                  "provenance": "difficulty-calibration tier (occlusion + heavy warps + "
+                                "distractors); MET = model beats the linear-probe baseline"}
+        met = bool(achieved is not None and achieved > baseline_acc)
+    else:
+        target = {"metric": "val_acc", "value": 0.98,
+                  "provenance": "reference MNIST bar, docs/training-examples.md:144-150 (0.98160)"}
+        met = bool(accs and max(accs) >= 0.98)
     return {
         "task": task_name or f"digits_{source}",
         "model_params": n_params,
-        "target": {"metric": "val_acc", "value": 0.98,
-                   "provenance": "reference MNIST bar, docs/training-examples.md:144-150 (0.98160)"},
-        "achieved": max(accs) if accs else None,
-        "met": bool(accs and max(accs) >= 0.98),
+        "target": target,
+        "achieved": achieved,
+        "baseline_val_acc": baseline_acc,
+        "baseline": "multinomial logistic regression on raw pixels (10k train cap)",
+        "met": met,
         "history": history,
     }
 
 
-def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
+def run_clm(source: str, steps: int, task_name: str = "", profile: str = "", production: bool = False):
+    """``production=True`` (the ``clm_markov_sharded`` family) trains the SAME
+    recipe through the flagship execution path instead of the single-device
+    default: a virtual data(2) x fsdp(4) mesh (ZeRO-3 param/moment sharding,
+    XLA-inserted collectives — the reference's clm_fsdp.py:24-36 regime), bf16
+    compute with fp32 params/softmax, dots-saveable remat over the scanned
+    layer stack, and single-GEMM fused qkv. Converging to the SAME analytic
+    floor upgrades the 2-step loss-equality tests (test_training_parallel.py)
+    to 'the sharded production path trains to the provable optimum'."""
     from perceiver_io_tpu.data.text.synthetic import SyntheticTextDataModule
     from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
     from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
@@ -134,9 +183,14 @@ def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
         # model push train CE below the floor by memorization while val CE
         # climbs (observed: train 0.90 vs floor 1.23 on a looped 1M corpus)
         batch = 16
+        # sharded eval consumes whole batches over the mesh's data axes, so the
+        # production run sizes the val split to an exact batch multiple (192
+        # windows = 12 full batches); the single-device profiles keep the
+        # round number (ragged last batch is fine there)
+        n_val = 192 * seq if production else (50_000 if small else 100_000)  # windows = n_val_tokens // seq
         data = SyntheticTextDataModule(source="markov", seq_len=seq, batch_size=batch,
                                        n_train_tokens=steps * batch * (seq + 1),
-                                       n_val_tokens=50_000 if small else 100_000,
+                                       n_val_tokens=n_val,
                                        vocab_size=32 if small else 64)
     else:
         data = SyntheticTextDataModule(source="python_source", seq_len=seq if small else 1024,
@@ -145,14 +199,21 @@ def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
                                        n_val_tokens=200_000 if small else 400_000)
     data.setup()
 
+    knobs = dict(
+        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
+        fused_qkv=True,
+    ) if production else {}
+    mesh_axes = {"data": 2, "fsdp": 4} if production else None
+    dtype = jnp.bfloat16 if production else None
     config = CausalSequenceModelConfig(
         vocab_size=data.effective_vocab_size, max_seq_len=data.seq_len,
         max_latents=data.seq_len // 2, num_channels=128 if small else 256,
         num_heads=4 if small else 8,
         num_self_attention_layers=2 if small else 4, cross_attention_dropout=0.0,
+        **knobs,
     )
-    model = CausalSequenceModel(config=config, deterministic=False)
-    eval_model = CausalSequenceModel(config=config, deterministic=True)
+    model = CausalSequenceModel(config=config, deterministic=False, dtype=dtype)
+    eval_model = CausalSequenceModel(config=config, deterministic=True, dtype=dtype)
 
     x = jnp.zeros((2, data.seq_len), jnp.int32)
     rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)}
@@ -164,6 +225,7 @@ def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
         make_eval_step=lambda m: make_causal_lm_eval_step(m, max_latents=config.max_latents),
         monitor="loss", monitor_mode="min", warmup_cap=150,
         init_fn=lambda: model.init(rngs, x, prefix_len=data.seq_len - config.max_latents),
+        mesh_axes=mesh_axes,
     )
 
     losses = [h["val_loss"] for h in history if "val_loss" in h]
@@ -175,6 +237,13 @@ def run_clm(source: str, steps: int, task_name: str = "", profile: str = ""):
         "history": history,
     }
     out["profile"] = profile
+    if production:
+        out["execution_path"] = {
+            "mesh": mesh_axes, "parallel_mode": "fsdp (ZeRO-3 param/moment sharding)",
+            "dtype": "bfloat16 compute, float32 params + softmax/LN stats",
+            "remat_policy": config.remat_policy, "fused_qkv": config.fused_qkv,
+            "scanned_layers": True,
+        }
     if source == "markov":
         floor = float(data.entropy_floor)
         out["target"] = {"metric": "val_loss", "value": floor, "tolerance_nats": 0.05,
@@ -251,8 +320,11 @@ def run_audio_markov(steps: int, profile: str = ""):
 
 TASKS = {
     "digits_glyphs": lambda steps: run_digits("glyphs", steps or 3000, "digits_glyphs"),
+    "digits_glyphs_hard": lambda steps: run_digits("glyphs_hard", steps or 3000, "digits_glyphs_hard"),
     "digits_sklearn": lambda steps: run_digits("sklearn_digits", steps or 2000, "digits_sklearn"),
     "clm_markov": lambda steps: run_clm("markov", steps or 2000, "clm_markov"),
+    "clm_markov_sharded": lambda steps: run_clm("markov", steps or 4000, "clm_markov_sharded",
+                                                profile="cpu", production=True),
     "clm_pysrc": lambda steps: run_clm("python_source", steps or 2000, "clm_pysrc"),
     "audio_markov": lambda steps: run_audio_markov(steps or 2500),
 }
@@ -295,6 +367,12 @@ def render(out_dir: str, md_path: str = "CONVERGENCE.md") -> None:
         ach = r.get("achieved", r.get("achieved_val_ce_nats"))
         ach_s = "n/a (no eval points recorded)" if ach is None else f"{ach:.5g}"
         lines.append(f"- achieved: {ach_s} — **{'MET' if r.get('met') else 'NOT MET'}**")
+        if r.get("baseline_val_acc") is not None:
+            lines.append(f"- trivial baseline: {r['baseline_val_acc']:.5g} ({r.get('baseline', 'linear probe')})")
+        if r.get("execution_path"):
+            ep = r["execution_path"]
+            lines.append(f"- execution path: mesh {ep['mesh']}, {ep['parallel_mode']}; {ep['dtype']}; "
+                         f"remat {ep['remat_policy']}; fused_qkv {ep['fused_qkv']}")
         if r.get("entropy_floor_nats") is not None:
             lines.append(f"- analytic floor: {r['entropy_floor_nats']:.5g} nats; gap: {r['gap_nats']:.4g} nats")
         if r.get("bits_per_byte") is not None:
@@ -343,6 +421,15 @@ def main(argv=None):
 
     os.makedirs(args.out, exist_ok=True)
     names = list(TASKS) if args.task == "all" else [args.task]
+    if "clm_markov_sharded" in names and jax.device_count() != 8:
+        msg = (f"clm_markov_sharded needs exactly 8 devices for its data(2) x fsdp(4) "
+               f"mesh (have {jax.device_count()}); run with JAX_PLATFORMS=cpu "
+               "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        if args.task == "all":
+            names.remove("clm_markov_sharded")
+            print(f"skipping clm_markov_sharded: {msg}")
+        else:
+            raise SystemExit(msg)
     for name in names:
         result = TASKS[name](args.steps)
         path = os.path.join(args.out, f"{name}.json")

@@ -63,3 +63,58 @@ def fit_scaling_law(
         k_n=fit_power_law(flops_arr, params_arr, m=a),
         k_d=fit_power_law(flops_arr, tokens_arr, m=b),
     )
+
+
+def fit_power_law_free(xs: Sequence[float], ys: Sequence[float]) -> tuple:
+    """Log-log least squares of y = k * x**m with the EXPONENT free: returns
+    (k, m). This is Chinchilla Approach-1 style estimation (the reference's
+    laws.py fits with scipy curve_fit; in log space the same objective is an
+    ordinary linear regression, scipy-free)."""
+    lx = np.log(np.asarray(xs, float))
+    ly = np.log(np.asarray(ys, float))
+    m, c = np.polyfit(lx, ly, 1)
+    return float(np.exp(c)), float(m)
+
+
+def fit_scaling_law_free(
+    flops_arr: Sequence[float],
+    params_arr: Sequence[float],
+    tokens_arr: Sequence[float],
+) -> ScalingLaw:
+    """``fit_scaling_law`` with the exponents ESTIMATED from the frontier
+    rather than assumed — the honest headline when the data identify them."""
+    k_n, a = fit_power_law_free(flops_arr, params_arr)
+    k_d, b = fit_power_law_free(flops_arr, tokens_arr)
+    return ScalingLaw(a=a, b=b, k_n=k_n, k_d=k_d)
+
+
+def bootstrap_exponents(
+    flops_arr: Sequence[float],
+    params_arr: Sequence[float],
+    tokens_arr: Sequence[float],
+    n_boot: int = 2000,
+    seed: int = 0,
+) -> dict:
+    """Percentile 95% CIs for the freely-fitted exponents, bootstrapped over
+    frontier points. Wide intervals are the point: they record how weakly a
+    small ladder identifies the exponent instead of overstating a clean 0.50."""
+    flops = np.asarray(flops_arr, float)
+    params = np.asarray(params_arr, float)
+    tokens = np.asarray(tokens_arr, float)
+    rng = np.random.default_rng(seed)
+    n = len(flops)
+    a_s, b_s = [], []
+    for _ in range(n_boot):
+        idx = rng.integers(0, n, n)
+        if np.unique(flops[idx]).size < 2:
+            continue  # degenerate resample: exponent unidentifiable
+        _, a = fit_power_law_free(flops[idx], params[idx])
+        _, b = fit_power_law_free(flops[idx], tokens[idx])
+        a_s.append(a)
+        b_s.append(b)
+    lo, hi = 2.5, 97.5
+    return {
+        "a_ci95": [float(np.percentile(a_s, lo)), float(np.percentile(a_s, hi))],
+        "b_ci95": [float(np.percentile(b_s, lo)), float(np.percentile(b_s, hi))],
+        "n_boot_effective": len(a_s),
+    }

@@ -18,6 +18,13 @@ if "--xla_force_host_platform_device_count" not in _flags:
 # flags. Never set for benchmarks.
 if "--xla_backend_optimization_level" not in _flags:
     _flags += " --xla_backend_optimization_level=0 --xla_llvm_disable_expensive_passes=true"
+# 8 virtual device threads share ONE physical core on this host; XLA:CPU kills
+# the whole process (F rendezvous.cc) if a collective participant is starved
+# past 40s, which concurrent compiles/processes can trigger. Raise the fatal
+# threshold; starvation then shows up as a warning + slow test, not an abort.
+if "--xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=600"
+               " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120")
 os.environ["XLA_FLAGS"] = _flags.strip()
 
 import jax  # noqa: E402
@@ -77,6 +84,14 @@ def pytest_collection_modifyitems(config, items):
     if deselected:
         config.hook.pytest_deselected(items=deselected)
         items[:] = selected
+        # file/dir path and -k selections still drop the slow tier; say so once
+        # instead of leaving a silently shrunken (or empty) selection
+        reporter = config.pluginmanager.get_plugin("terminalreporter")
+        if reporter is not None:
+            reporter.write_line(
+                f"conftest: {len(deselected)} slow-tier tests deselected "
+                '(select them with -m slow, -m "", or a ::node-id)'
+            )
 
 
 @pytest.fixture(scope="module")

@@ -282,3 +282,66 @@ def test_checkpoint_manager_retention_and_best(tmp_path):
     assert int(best["step"]) == 2
     np.testing.assert_allclose(np.asarray(best["w"]), np.arange(4.0) + 2)
     mgr.close()
+
+
+def test_convergence_sharded_task_guards_device_count(monkeypatch, tmp_path):
+    """--task clm_markov_sharded on a <8-device backend exits with the exact
+    command needed; --task all instead skips it (no crash mid-run)."""
+    from perceiver_io_tpu.scripts import convergence
+
+    monkeypatch.setattr(jax, "device_count", lambda: 1)
+    with pytest.raises(SystemExit, match="xla_force_host_platform_device_count"):
+        convergence.main(["--task", "clm_markov_sharded", "--out", str(tmp_path)])
+
+
+def test_scaling_law_free_fit_and_bootstrap():
+    """fit_scaling_law_free recovers a known power law exactly, and the
+    bootstrap CI brackets the true exponent on noisy data."""
+    from perceiver_io_tpu.training.scaling import bootstrap_exponents, fit_scaling_law_free
+
+    rng = np.random.default_rng(0)
+    flops = np.logspace(10, 14, 24)
+    params = 0.4 * flops**0.5
+    tokens = 0.9 * flops**0.5
+    law = fit_scaling_law_free(flops, params, tokens)
+    np.testing.assert_allclose([law.a, law.b], [0.5, 0.5], atol=1e-9)
+    np.testing.assert_allclose([law.k_n, law.k_d], [0.4, 0.9], rtol=1e-9)
+
+    noisy_p = params * np.exp(rng.normal(0, 0.05, flops.size))
+    noisy_t = tokens * np.exp(rng.normal(0, 0.05, flops.size))
+    cis = bootstrap_exponents(flops, noisy_p, noisy_t, n_boot=500, seed=1)
+    # a 95% CI may legitimately miss the truth ~5% of the time, so pin the
+    # robust properties instead: near the truth, narrow, and properly ordered
+    for lo, hi in (cis["a_ci95"], cis["b_ci95"]):
+        assert lo < hi
+        assert abs((lo + hi) / 2 - 0.5) < 0.05
+        assert hi - lo < 0.2
+    assert cis["n_boot_effective"] > 400
+
+
+def test_refit_reports_identification(tmp_path):
+    """refit() on synthetic two-run CSVs: records law_free + CIs and counts
+    interior points only where ranges genuinely overlap."""
+    import csv as _csv
+
+    from perceiver_io_tpu.scripts.scaling_study import refit
+
+    runs = [
+        {"name": "small", "params": 1000, "csv": "run_small.csv"},
+        {"name": "big", "params": 4000, "csv": "run_big.csv"},
+    ]
+    with open(tmp_path / "runs.json", "w") as f:
+        json.dump(runs, f)
+    # small wins low budgets INSIDE big's range (interior); big wins the tail
+    rows_small = [(s, s * 100, s * 1e9, 3.0 - 0.01 * s) for s in range(10, 100, 10)]
+    rows_big = [(s, s * 100, s * 4e9, 3.5 - 0.02 * s) for s in range(5, 100, 10)]
+    for name, rows in (("run_small.csv", rows_small), ("run_big.csv", rows_big)):
+        with open(tmp_path / name, "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(["step", "tokens", "train_flops", "val_loss"])
+            w.writerows(rows)
+    result = refit(str(tmp_path))
+    assert "law_free" in result and "exponent_ci95" in result
+    assert result["n_interior_points"] >= 2
+    assert all(p["params"] == 1000 for p in result["interior_points"])
+    assert os.path.exists(tmp_path / "law.json")

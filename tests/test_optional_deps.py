@@ -155,10 +155,12 @@ def _fake_pretty_midi():
     return pm
 
 
-def test_encode_decode_midi_roundtrip(monkeypatch):
+def test_encode_decode_midi_roundtrip(tmp_path):
+    """pretty_midi-SHAPED input (duck-typed .instruments) -> tokens -> native
+    SMF document + real .mid file; the written file re-parses natively."""
     pm = _fake_pretty_midi()
-    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
     from perceiver_io_tpu.data.audio import midi_processor as mp
+    from perceiver_io_tpu.data.audio.smf import read_smf
 
     midi = pm.PrettyMIDI()
     inst = pm.Instrument(0)
@@ -168,26 +170,24 @@ def test_encode_decode_midi_roundtrip(monkeypatch):
     tokens = mp.encode_midi(midi)
     assert tokens and all(isinstance(t, int) for t in tokens)
 
-    out = mp.decode_midi(tokens, file_path="/tmp/x.mid")
-    assert out.written_to == "/tmp/x.mid"
-    notes = out.instruments[0].notes
-    assert [(n.pitch, n.start) for n in notes] == [(60, 0.0), (72, 0.25)]
+    out_path = tmp_path / "x.mid"
+    out = mp.decode_midi(tokens, file_path=str(out_path))
+    assert [(n.pitch, n.start) for n in out.notes] == [(60, 0.0), (72, 0.25)]
     # velocity is quantized to steps of 4 by the event codec
-    assert all(abs(a.velocity - b.velocity) <= 4 for a, b in zip(notes, inst.notes))
+    assert all(abs(a.velocity - b.velocity) <= 4 for a, b in zip(out.notes, inst.notes))
+    reloaded = read_smf(out_path)
+    assert [(n.pitch, n.start) for n in reloaded.notes] == [(60, 0.0), (72, 0.25)]
 
 
-def test_encode_midi_file_skips_unreadable(monkeypatch, capsys):
-    pm = _fake_pretty_midi()
-
-    def boom(path):
-        raise OSError("corrupt file")
-
-    pm.PrettyMIDI = boom
-    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
+def test_encode_midi_file_skips_unreadable(tmp_path, capsys):
     from perceiver_io_tpu.data.audio import midi_processor as mp
 
-    assert mp.encode_midi_file("/nope/x.mid") is None
-    assert "Error encoding midi file" in capsys.readouterr().out
+    assert mp.encode_midi_file("/nope/x.mid") is None  # missing file
+    bad = tmp_path / "bad.mid"
+    bad.write_bytes(b"not a midi file at all")
+    assert mp.encode_midi_file(str(bad)) is None  # malformed header
+    out = capsys.readouterr().out
+    assert out.count("Error encoding midi file") == 2
 
 
 # ------------------------------------------------- fluidsynth render + pipeline
@@ -221,16 +221,16 @@ def test_render_wav_command(monkeypatch):
 
 
 @pytest.mark.slow
-def test_symbolic_audio_pipeline_midi_path_input(monkeypatch, tmp_path):
-    """End-to-end pipeline with a .mid path prompt: fake pretty_midi load,
-    real codec, real (tiny) model generate, fake pretty_midi output."""
+def test_symbolic_audio_pipeline_midi_path_input(tmp_path):
+    """End-to-end pipeline with a REAL .mid path prompt: native SMF parse,
+    real codec, real (tiny) model generate, native SMF output file — zero
+    optional dependencies anywhere (the reference needs pretty_midi for this,
+    audio/symbolic/huggingface.py:127-190)."""
     import jax
     import jax.numpy as jnp
 
-    pm = _fake_pretty_midi()
-    pm.PrettyMIDI.preset_notes = [pm.Note(64, 60, 0.0, 0.3), pm.Note(72, 62, 0.3, 0.6)]
-    monkeypatch.setitem(sys.modules, "pretty_midi", pm)
-
+    from perceiver_io_tpu.data.audio.midi_processor import Note
+    from perceiver_io_tpu.data.audio.smf import read_smf, write_smf
     from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
     from perceiver_io_tpu.pipelines import SymbolicAudioPipeline
 
@@ -242,7 +242,12 @@ def test_symbolic_audio_pipeline_midi_path_input(monkeypatch, tmp_path):
     params = model.init(rng, x, prefix_len=8)
 
     mid_path = tmp_path / "prompt.mid"
-    mid_path.write_bytes(b"")
+    write_smf(mid_path, [Note(60, 64, 0.0, 0.3), Note(62, 72, 0.3, 0.6)])
     pipe = SymbolicAudioPipeline(model=model, params=params)
-    out = pipe(str(mid_path), num_latents=4, max_new_tokens=4, output_midi_path=str(tmp_path / "gen.mid"))
-    assert out.written_to == str(tmp_path / "gen.mid")
+    gen_path = tmp_path / "gen.mid"
+    out = pipe(str(mid_path), num_latents=4, max_new_tokens=4, output_midi_path=str(gen_path))
+    assert gen_path.stat().st_size > 0
+    # the written continuation re-parses; its notes match the returned document
+    assert [(n.pitch, n.velocity) for n in read_smf(gen_path).notes] == [
+        (n.pitch, n.velocity) for n in out.notes
+    ]
