@@ -175,10 +175,15 @@ class CrossAttention(nn.Module):
         rope_k: Optional[jax.Array] = None,
         kv_cache: Optional[KVCache] = None,
     ) -> Tuple[jax.Array, Optional[KVCache]]:
-        x_q = self.q_norm(x_q)
+        from perceiver_io_tpu.parallel.mesh import constrain_batch_sharded
+
+        x_q = constrain_batch_sharded(self.q_norm(x_q))
         if x_kv is None:
             x_kv_prefix = self.kv_norm(x_kv_prefix)
-            x_kv = jnp.concatenate([x_kv_prefix, x_q], axis=1)
+            # batch-pin the concat: XLA's propagation otherwise channel-shards
+            # this intermediate and pays a replicate-then-reshard before the
+            # fsdp kv projection (see constrain_batch_sharded)
+            x_kv = constrain_batch_sharded(jnp.concatenate([x_kv_prefix, x_q], axis=1))
         else:
             x_kv = self.kv_norm(x_kv)
         return self.attention(x_q, x_kv, pad_mask=pad_mask, rope_q=rope_q, rope_k=rope_k, kv_cache=kv_cache)

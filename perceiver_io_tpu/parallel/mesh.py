@@ -80,6 +80,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def constrain_batch_sharded(x: jax.Array) -> jax.Array:
+    """Pin dim 0 of an activation to the ambient mesh's data axes, leaving the
+    other dims unconstrained. A propagation HINT, not a reshard: XLA's sharding
+    propagation sometimes picks a channel-sharded layout for small norm/concat
+    intermediates and then pays an 'involuntary full rematerialization'
+    (replicate-then-reshard) to feed the next fsdp GEMM — observed on the
+    Perceiver AR cross-attention q_norm/concat under data x fsdp meshes. No-op
+    without an ambient mesh or without data axes (single device, pure
+    tensor/seq meshes), so module code can call it unconditionally."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    sizes = dict(mesh.shape)
+    axes = tuple(a for a in DATA_AXES if sizes.get(a, 1) > 1)
+    if not axes:
+        return x
+    spec = PartitionSpec(axes, *([PartitionSpec.UNCONSTRAINED] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def local_batch_to_global(batch, mesh: Mesh):
     """Multi-host data loading: each process holds its local shard of the batch
     (the jax-native replacement for the reference's ``split_dataset_by_node``,
