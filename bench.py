@@ -292,7 +292,7 @@ _DRIVER_TASKS = ("clm", "clm_8k", "optical_flow", "decode")
 _PROBE_TIMEOUT_S = 180
 _PROBE_BACKOFFS_S = (15, 30, 60, 120, 240)
 _PROBE_CODE = "import jax; print('devices:', jax.devices(), flush=True)"
-_TASK_TIMEOUT_S = {"clm": 1800, "clm_8k": 1500, "optical_flow": 1500, "decode": 1800}
+_TASK_TIMEOUT_S = {"clm": 1800, "clm_8k": 1500, "optical_flow": 1500, "decode": 2700}
 _TASK_TIMEOUT_DEFAULT_S = 1800
 # Overridable for the orchestrator self-test (tests/test_bench_driver.py): a
 # stub script stands in for real benchmark subprocesses so the success path —
