@@ -227,7 +227,9 @@ def bench_decode():
     params = jax.jit(model.init, static_argnames="prefix_len")(rng, x, prefix_len=prompt_len - config.max_latents)
 
     def measure(gcfg):
-        out = generate(model, params, x, num_latents=1, rng=rng, config=gcfg)
+        # warmup compiles AND yields the speculation stats (identical every run:
+        # greedy is deterministic); the timed loop then runs stat-free
+        out, stats = generate(model, params, x, num_latents=1, rng=rng, config=gcfg, return_stats=True)
         float(jnp.abs(out).sum())  # compile + host-fetch sync (see bench_clm note)
         best = float("inf")
         for _ in range(3):
@@ -235,7 +237,7 @@ def bench_decode():
             out = generate(model, params, x, num_latents=1, rng=rng, config=gcfg)
             float(jnp.abs(out).sum())
             best = min(best, time.perf_counter() - t0)
-        return b * new_tokens / best
+        return b * new_tokens / best, stats
 
     chunked = GenerationConfig(max_new_tokens=new_tokens, decode_chunk=8)
     single = GenerationConfig(max_new_tokens=new_tokens)
@@ -244,13 +246,13 @@ def bench_decode():
     if prior not in (None, "", "0", "false"):
         sys.exit("unset PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL before benchmarking: "
                  "the fused measurement would silently run with the kernel off")
-    chunked_tps = measure(chunked)
-    single_tps = measure(single)
+    chunked_tps, chunk_stats = measure(chunked)
+    single_tps, _ = measure(single)
 
     os.environ["PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL"] = "1"
     jax.clear_caches()  # kernel selection is a trace-time decision
     try:
-        xla_tps = measure(chunked)
+        xla_tps, _ = measure(chunked)
     finally:
         if prior is None:
             del os.environ["PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL"]
@@ -266,6 +268,12 @@ def bench_decode():
         "single_token_tps": round(single_tps, 1),
         "kernel_off_chunked_tps": round(xla_tps, 1),
         "kernel_speedup": round(chunked_tps / xla_tps, 4),
+        # speculation quality on this (untrained) model: chunk-phase tokens per
+        # multi-query iteration, in [1, decode_chunk]
+        "accept_rate": round(
+            chunk_stats["chunked_tokens"] / max(chunk_stats["chunk_iterations"], 1), 3
+        ),
+        "tail_steps": chunk_stats["tail_steps"],
     }
 
 

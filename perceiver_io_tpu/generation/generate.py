@@ -143,13 +143,14 @@ def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: in
 
     out_buf = jnp.zeros((b, max_new + n), jnp.int32)
     emitted0 = jnp.zeros((), jnp.int32)
+    iters0 = jnp.zeros((), jnp.int32)
     guesses0 = jnp.zeros((b, n - 1), jnp.int32)
 
     def chunk_cond(carry):
         return carry[0] + n <= k_chunk  # a full chunk still fits the no-roll budget
 
     def chunk_body(carry):
-        emitted, cache, next_logits, guesses, out_buf = carry
+        emitted, iters, cache, next_logits, guesses, out_buf = carry
         tok0 = jnp.argmax(next_logits, axis=-1).astype(jnp.int32)  # always-correct head token
         cand = jnp.concatenate([tok0[:, None], guesses], axis=1)  # (B, n)
         logits_blk, cache = model.apply(params, cand, cache, method=type(model).decode_block)
@@ -165,11 +166,12 @@ def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: in
         # refreshed guesses: the just-computed continuations shifted to the new
         # frontier (clamped gather; trailing slots just repeat the last one)
         guesses = jnp.take(y, jnp.minimum(m + jnp.arange(n - 1), n - 1), axis=1)
-        return emitted + m, cache, next_logits, guesses, out_buf
+        return emitted + m, iters + 1, cache, next_logits, guesses, out_buf
 
-    emitted, cache, next_logits, _, out_buf = jax.lax.while_loop(
-        chunk_cond, chunk_body, (emitted0, cache, next_logits, guesses0, out_buf)
+    emitted, chunk_iters, cache, next_logits, _, out_buf = jax.lax.while_loop(
+        chunk_cond, chunk_body, (emitted0, iters0, cache, next_logits, guesses0, out_buf)
     )
+    chunked_tokens = emitted
 
     def tail_cond(carry):
         return carry[0] < max_new
@@ -181,8 +183,16 @@ def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: in
         out_buf = jax.lax.dynamic_update_slice(out_buf, tok[:, None], (jnp.zeros((), emitted.dtype), emitted))
         return emitted + 1, cache, logits_t[:, -1], out_buf
 
-    _, _, _, out_buf = jax.lax.while_loop(tail_cond, tail_body, (emitted, cache, next_logits, out_buf))
-    return jnp.concatenate([input_ids, out_buf[:, :max_new].astype(input_ids.dtype)], axis=1)
+    emitted, _, _, out_buf = jax.lax.while_loop(tail_cond, tail_body, (emitted, cache, next_logits, out_buf))
+    tokens = jnp.concatenate([input_ids, out_buf[:, :max_new].astype(input_ids.dtype)], axis=1)
+    # iteration accounting: acceptance rate = chunk-phase tokens per chunk
+    # iteration (>= 1 by construction; == decode_chunk at perfect speculation)
+    stats = {
+        "chunk_iterations": chunk_iters,
+        "chunked_tokens": chunked_tokens,
+        "tail_steps": emitted - chunked_tokens,
+    }
+    return tokens, stats
 
 
 @partial(jax.jit, static_argnames=("model", "config", "prefix_len"))
@@ -326,13 +336,17 @@ def generate(
     pad_mask: Optional[jax.Array] = None,
     rng: Optional[jax.Array] = None,
     config: Optional[GenerationConfig] = None,
+    return_stats: bool = False,
     **kwargs,
-) -> jax.Array:
+) -> "jax.Array | tuple[jax.Array, dict]":
     """Generate ``config.max_new_tokens`` tokens after ``input_ids`` (B, N).
 
     ``num_latents`` is the initial number of latent positions assigned to the end
     of the prompt (reference core/huggingface.py:187-230); the latent/prefix
-    window then evolves automatically via the roll caches. Returns (B, N + new).
+    window then evolves automatically via the roll caches. Returns (B, N + new);
+    with ``return_stats=True``, ``(tokens, stats)`` where stats reports the
+    chunked path's iteration accounting (chunk_iterations / chunked_tokens /
+    tail_steps — acceptance rate = chunked_tokens / chunk_iterations).
     """
     if config is None:
         config = GenerationConfig(**kwargs)
@@ -353,7 +367,10 @@ def generate(
                 "num_beams=1, penalty_alpha=None and eos_token_id=None — draft "
                 "verification is exact only for the deterministic greedy chain"
             )
-        return _generate_chunked(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+        tokens, stats = _generate_chunked(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+        if return_stats:
+            return tokens, {k: int(v) for k, v in stats.items()}
+        return tokens
     if config.penalty_alpha is not None and config.penalty_alpha > 0:
         if not config.top_k or config.top_k < 2:
             raise ValueError("contrastive search requires top_k >= 2 with penalty_alpha")
@@ -361,9 +378,14 @@ def generate(
             raise ValueError("penalty_alpha (contrastive search) is incompatible with do_sample/num_beams")
         if config.temperature != 1.0 or (config.top_p is not None and config.top_p < 1.0):
             raise ValueError("temperature/top_p have no effect in contrastive search; leave them at defaults")
-        return _generate_contrastive(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
-    if config.num_beams > 1:
+        out = _generate_contrastive(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+    elif config.num_beams > 1:
         # do_sample=False: classic beam search; do_sample=True: beam-multinomial
         # (HF GenerationMixin beam_sample, reference core/huggingface.py:187-230)
-        return _generate_beam(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
-    return _generate_single(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+        out = _generate_beam(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+    else:
+        out = _generate_single(model, params, input_ids, pad_mask, rng, prefix_len=prefix_len, config=config)
+    if return_stats:
+        # non-chunked modes decode one token per sequential step
+        return out, {"chunk_iterations": 0, "chunked_tokens": 0, "tail_steps": config.max_new_tokens}
+    return out

@@ -100,9 +100,15 @@ def test_chunked_generate_equals_token_by_token(setup):
     slide for the remaining tokens)."""
     model, params, prompt = setup
     seq = generate(model, params, prompt, num_latents=4, max_new_tokens=16)
-    chunked = generate(model, params, prompt, num_latents=4, max_new_tokens=16, decode_chunk=4)
+    chunked, stats = generate(
+        model, params, prompt, num_latents=4, max_new_tokens=16, decode_chunk=4, return_stats=True
+    )
     assert chunked.shape == seq.shape == (2, 32)
     np.testing.assert_array_equal(np.asarray(chunked), np.asarray(seq))
+    # iteration accounting: every emitted token is attributed to exactly one
+    # phase, and the chunk phase commits >= 1 token per iteration
+    assert stats["chunked_tokens"] + stats["tail_steps"] == 16
+    assert 1 <= stats["chunk_iterations"] <= stats["chunked_tokens"] <= 4  # k_chunk = 4 here
 
 
 def test_chunk_larger_than_headroom_still_exact(setup):
