@@ -144,7 +144,9 @@ def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: in
     out_buf = jnp.zeros((b, max_new + n), jnp.int32)
     emitted0 = jnp.zeros((), jnp.int32)
     iters0 = jnp.zeros((), jnp.int32)
-    guesses0 = jnp.zeros((b, n - 1), jnp.int32)
+    # first drafts: repeat the prompt's last token — a free repetition prior
+    # that only affects acceptance (how many drafts verify), never the output
+    guesses0 = jnp.broadcast_to(input_ids[:, -1:].astype(jnp.int32), (b, n - 1))
 
     def chunk_cond(carry):
         return carry[0] + n <= k_chunk  # a full chunk still fits the no-roll budget
