@@ -3,7 +3,12 @@
 With no args (driver mode) a hardened orchestrator probes backend init with
 retries/backoff, runs the headline + clm_8k + optical_flow + decode tasks in
 isolated subprocesses (per-task records printed as they land), and ends with
-ONE JSON line — the headline record plus a "tasks" field carrying all four:
+ONE JSON line — the headline record plus a "tasks" field carrying all four.
+``--watch [interval_s]`` runs the round-long opportunistic harness: probe on a
+schedule, persist the first successful record per task to BENCH_partial.json,
+log every attempt to bench_attempts.jsonl; driver mode folds those records in
+when its own live attempts fail (tunnel up at ANY point this round => complete
+artifact at round end). Headline contract:
 
   {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": MFU/0.40,
    "tasks": {...}}
@@ -302,6 +307,33 @@ _PROBE_BACKOFFS_S = (15, 30, 60, 120, 240)
 _PROBE_CODE = "import jax; print('devices:', jax.devices(), flush=True)"
 _TASK_TIMEOUT_S = {"clm": 1800, "clm_8k": 1500, "optical_flow": 1500, "decode": 2700}
 _TASK_TIMEOUT_DEFAULT_S = 1800
+# Round-long opportunistic harness state (VERDICT r4 item 1). The watcher
+# (``--watch``) persists the FIRST successful record per task here, with an
+# attempt log alongside; driver mode folds these in when its own live attempts
+# fail, so a tunnel that was up at ANY point during the round still yields a
+# complete BENCH artifact at round end.
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+_PARTIAL_PATH = os.path.join(_REPO_DIR, "BENCH_partial.json")
+_ATTEMPTS_PATH = os.path.join(_REPO_DIR, "bench_attempts.jsonl")
+_PROGRESS_PATH = os.path.join(_REPO_DIR, "PROGRESS.jsonl")
+_LOCK_PATH = os.path.join(_REPO_DIR, ".bench.lock")
+_WATCH_INTERVAL_S = 1200
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _current_round():
+    """The driver's round counter (last PROGRESS.jsonl line), or None outside
+    driver-managed checkouts. Scopes BENCH_partial.json to ONE round: records
+    captured in round N must not masquerade as round N+1 measurements."""
+    try:
+        with open(_PROGRESS_PATH) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+        return json.loads(lines[-1]).get("round") if lines else None
+    except (OSError, ValueError):
+        return None
 # Overridable for the orchestrator self-test (tests/test_bench_driver.py): a
 # stub script stands in for real benchmark subprocesses so the success path —
 # per-task records as they land, headline-with-"tasks" contract, rc semantics —
@@ -313,29 +345,99 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _probe_backend_once() -> tuple[bool, str]:
+    """One killable backend-init probe; returns (ok, detail)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init HUNG past {_PROBE_TIMEOUT_S}s (tunnel wedged?) — killed the probe"
+    if proc.returncode == 0:
+        out = proc.stdout.strip()
+        return True, out.splitlines()[-1] if out else "backend up"
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    return False, "backend init failed: " + " | ".join(tail)
+
+
 def _probe_backend() -> bool:
     """Initialize the accelerator backend in a subprocess (killable on hang),
     retrying with backoff. Returns True once jax.devices() answers."""
-    import subprocess
-
-    code = _PROBE_CODE
     for attempt, backoff in enumerate((0,) + _PROBE_BACKOFFS_S):
         if backoff:
             _log(f"backend probe retry in {backoff}s (attempt {attempt + 1}/{1 + len(_PROBE_BACKOFFS_S)})")
             time.sleep(backoff)
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S
-            )
-        except subprocess.TimeoutExpired:
-            _log(f"backend init HUNG past {_PROBE_TIMEOUT_S}s (tunnel wedged?) — killed the probe")
-            continue
-        if proc.returncode == 0:
-            _log(proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "backend up")
+        ok, detail = _probe_backend_once()
+        _log(detail)
+        if ok:
             return True
-        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
-        _log("backend init failed: " + " | ".join(tail))
     return False
+
+
+def _load_partial() -> dict:
+    """Task records persisted by ``--watch`` successes THIS round; records
+    stamped with a different round are ignored (stale rounds must not fold in)."""
+    try:
+        with open(_PARTIAL_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    if data.get("round") != _current_round():
+        return {}
+    tasks = data.get("tasks")
+    return tasks if isinstance(tasks, dict) else {}
+
+
+def _save_partial(tasks: dict) -> None:
+    tmp = _PARTIAL_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"updated_at": _utc_now(), "round": _current_round(),
+                   "tasks": tasks}, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, _PARTIAL_PATH)
+
+
+def _log_attempt(event: str, **fields) -> None:
+    rec = {"ts": round(time.time(), 1), "iso": _utc_now(), "event": event, **fields}
+    with open(_ATTEMPTS_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+class _bench_lock:
+    """Advisory flock serializing probing AND measuring between a concurrent
+    ``--watch`` process and driver mode — even a probe subprocess (jax import +
+    backend init) on the one-core host skews a measurement in flight. Driver
+    mode blocks until the peer finishes (task subprocess timeouts bound the
+    wait); the watcher uses ``blocking=False`` and simply skips its cycle when
+    the peer holds the lock (``acquired`` tells it which happened)."""
+
+    def __init__(self, blocking: bool = True):
+        self._blocking = blocking
+        self.acquired = False
+
+    def __enter__(self):
+        import fcntl
+
+        self._f = open(_LOCK_PATH, "w")
+        try:
+            fcntl.flock(self._f, fcntl.LOCK_EX | (0 if self._blocking else fcntl.LOCK_NB))
+            self.acquired = True
+        except OSError:
+            self._f.close()
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+
+        if self.acquired:
+            fcntl.flock(self._f, fcntl.LOCK_UN)
+            self._f.close()
+            self.acquired = False
+        return False
 
 
 def _run_task_subprocess(task: str):
@@ -364,25 +466,93 @@ def _run_task_subprocess(task: str):
     return None, "failed after 2 attempts (see [bench] diagnostics above)"
 
 
-def _driver_main() -> int:
-    if not _probe_backend():
-        _log("UNRECOVERABLE: accelerator backend never initialized after "
-             f"{1 + len(_PROBE_BACKOFFS_S)} probes over ~{sum(_PROBE_BACKOFFS_S) // 60} min.")
-        _log("Diagnosis: the axon PJRT tunnel is down or wedged on this host — this is a platform "
-             "failure, not a framework one. Re-run `python bench.py` when the tunnel recovers; "
-             "each task also runs standalone via `python bench.py --task "
-             "clm|clm_8k|optical_flow|decode`.")
-        return 1
+def _watch_main(interval_s: float = _WATCH_INTERVAL_S) -> int:
+    """Round-long opportunistic harness (VERDICT r4 item 1): probe the backend
+    on a schedule for the WHOLE round, and the first time the tunnel answers,
+    run every driver task whose record is still missing, persisting each
+    success to ``BENCH_partial.json`` (driver mode folds these in at round
+    end). Every attempt — probe or task, success or failure — is appended to
+    ``bench_attempts.jsonl`` so a dead-all-round tunnel leaves a committed
+    log proving continuous coverage rather than a single early-round window."""
+    _log(f"watch mode: interval {interval_s:.0f}s, tasks {list(_DRIVER_TASKS)}, "
+         f"state {_PARTIAL_PATH}")
+    _log_attempt("watch_start", interval_s=interval_s, tasks=list(_DRIVER_TASKS))
+    while True:
+        partial = _load_partial()
+        missing = [t for t in _DRIVER_TASKS if t not in partial]
+        if not missing:
+            _log_attempt("watch_complete", tasks=sorted(partial))
+            _log("all task records captured — watcher exiting")
+            return 0
+        # the WHOLE cycle (probe included) runs under a nonblocking lock: a
+        # probe subprocess alongside a driver measurement would skew it, and a
+        # probe verdict from before a long lock wait would be hours stale
+        with _bench_lock(blocking=False) as lock:
+            if not lock.acquired:
+                _log_attempt("cycle_skipped_peer_running", missing=missing)
+                _log(f"peer bench run in flight — skipping this cycle; next in {interval_s:.0f}s")
+            else:
+                ok, detail = _probe_backend_once()
+                if not ok:
+                    _log_attempt("probe_failed", detail=detail, missing=missing)
+                    _log(f"probe failed ({len(missing)} task(s) still missing); "
+                         f"next attempt in {interval_s:.0f}s")
+                else:
+                    _log_attempt("probe_ok", detail=detail)
+                    _log(f"backend up — running missing tasks {missing}")
+                    for task in missing:
+                        t0 = time.time()
+                        rec, note = _run_task_subprocess(task)
+                        if rec is not None:
+                            rec = {**rec, "recorded_at": _utc_now(), "source": "watch"}
+                            fresh = _load_partial()
+                            fresh[task] = rec
+                            _save_partial(fresh)
+                            _log_attempt("task_ok", task=task, value=rec.get("value"),
+                                         vs_baseline=rec.get("vs_baseline"),
+                                         seconds=round(time.time() - t0, 1))
+                            print(json.dumps(rec), flush=True)
+                        else:
+                            _log_attempt("task_failed", task=task, note=note,
+                                         seconds=round(time.time() - t0, 1))
+        if any(t not in _load_partial() for t in _DRIVER_TASKS):
+            time.sleep(interval_s)  # some task still missing; otherwise exit at loop top
 
-    records = {}
-    for task in _DRIVER_TASKS:
-        rec, note = _run_task_subprocess(task)
-        if rec is not None:
-            records[task] = rec
-            print(json.dumps(rec), flush=True)  # partial evidence survives later failures
-        else:
-            records[task] = {"task": task, "error": note}
-            _log(f"task {task}: {note}")
+
+def _driver_main() -> int:
+    # lock first: a concurrent --watch probe or measurement would skew (or be
+    # skewed by) everything below, probes included, on the one-core host
+    with _bench_lock():
+        live = _probe_backend()
+        partial = _load_partial()  # read under the lock: watcher records are final now
+        if partial:
+            _log(f"opportunistic records available from this round's watcher: {sorted(partial)}")
+        if not live and not partial:
+            _log("UNRECOVERABLE: accelerator backend never initialized after "
+                 f"{1 + len(_PROBE_BACKOFFS_S)} probes over ~{sum(_PROBE_BACKOFFS_S) // 60} min, "
+                 "and no opportunistic records were captured by `bench.py --watch` this round.")
+            _log("Diagnosis: the axon PJRT tunnel is down or wedged on this host — this is a platform "
+                 "failure, not a framework one. Re-run `python bench.py` when the tunnel recovers; "
+                 "each task also runs standalone via `python bench.py --task "
+                 "clm|clm_8k|optical_flow|decode`.")
+            return 1
+
+        records = {}
+        for task in _DRIVER_TASKS:
+            rec = note = None
+            if live:
+                rec, note = _run_task_subprocess(task)
+            if rec is None and task in partial:
+                rec = partial[task]
+                _log(f"task {task}: folding in opportunistic record from "
+                     f"{rec.get('recorded_at', 'earlier this round')}"
+                     + (" (live attempt failed)" if live else " (tunnel down at round end)"))
+            if rec is not None:
+                records[task] = rec
+                print(json.dumps(rec), flush=True)  # partial evidence survives later failures
+            else:
+                records[task] = {"task": task, "error": note or "tunnel down; no opportunistic record"}
+                _log(f"task {task}: {records[task]['error']}")
 
     headline = records.get(_DRIVER_TASKS[0])
     if headline is None or "error" in headline:
@@ -394,6 +564,15 @@ def _driver_main() -> int:
 
 def main():
     args = sys.argv[1:]
+    if "--watch" in args:
+        idx = args.index("--watch")
+        interval = _WATCH_INTERVAL_S
+        if idx + 1 < len(args):
+            try:
+                interval = float(args[idx + 1])
+            except ValueError:
+                sys.exit(f"--watch takes an optional numeric interval in seconds, got {args[idx + 1]!r}")
+        sys.exit(_watch_main(interval))
     if "--task" not in args:
         sys.exit(_driver_main())
     idx = args.index("--task")
