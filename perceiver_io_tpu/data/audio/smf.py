@@ -159,14 +159,19 @@ def _parse_smf(data: bytes) -> SMF:
     while tracks_seen < ntrks and i + 8 <= len(data):
         tag = data[i : i + 4]
         (tlen,) = struct.unpack(">I", data[i + 4 : i + 8])
+        if i + 8 + tlen > len(data):
+            raise ValueError(
+                f"malformed SMF: truncated chunk {tag!r} declares {tlen} bytes "
+                f"but only {len(data) - i - 8} remain"
+            )
         if tag == b"MTrk":
             events, tempos = _parse_track(data[i + 8 : i + 8 + tlen])
             all_events.extend(events)
             all_tempos.extend(tempos)
             tracks_seen += 1
-        elif not tag.isalnum():
-            raise ValueError(f"malformed SMF: expected MTrk chunk, found {tag!r}")
-        # else: alien chunk (vendor extensions like Yamaha XF) — spec says skip
+        # else: alien chunk (vendor extensions like Yamaha XF) — the spec says
+        # skip ANY unrecognized chunk by its declared length (tags with spaces
+        # or punctuation are legal); only a length overrunning the file is fatal
         i += 8 + tlen
 
     to_sec = _tick_to_seconds(division, all_tempos)

@@ -96,6 +96,14 @@ def constrain_batch_sharded(x: jax.Array) -> jax.Array:
     axes = tuple(a for a in DATA_AXES if sizes.get(a, 1) > 1)
     if not axes:
         return x
+    n_data = 1
+    for a in axes:
+        n_data *= sizes[a]
+    if x.shape[0] % n_data:
+        # a batch the data axes cannot divide (e.g. a ragged final eval batch)
+        # must not FAIL the hint that exists only to speed up the common case —
+        # propagation falls back to whatever XLA picks, as before the hint
+        return x
     spec = PartitionSpec(axes, *([PartitionSpec.UNCONSTRAINED] * (x.ndim - 1)))
     return jax.lax.with_sharding_constraint(x, spec)
 

@@ -112,6 +112,13 @@ def bootstrap_exponents(
         _, b = fit_power_law_free(flops[idx], tokens[idx])
         a_s.append(a)
         b_s.append(b)
+    if not a_s:
+        # every resample was degenerate (single frontier point or a single
+        # distinct FLOPs value): the exponent is unidentifiable, which is an
+        # answer, not an error — keep --refit runnable on minimal ladders
+        return {"a_ci95": None, "b_ci95": None, "n_boot_effective": 0,
+                "note": "exponent unidentifiable: fewer than 2 distinct "
+                        "train-FLOPs values on the frontier"}
     lo, hi = 2.5, 97.5
     return {
         "a_ci95": [float(np.percentile(a_s, lo)), float(np.percentile(a_s, hi))],

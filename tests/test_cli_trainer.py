@@ -319,6 +319,20 @@ def test_scaling_law_free_fit_and_bootstrap():
     assert cis["n_boot_effective"] > 400
 
 
+def test_bootstrap_degenerate_ladder_returns_null_cis():
+    """A frontier with a single point (or one distinct FLOPs value) cannot
+    identify the exponent: the bootstrap must answer with null CIs, not crash
+    on an empty percentile — keeps --refit runnable on minimal committed
+    ladders (advisor r4 finding)."""
+    from perceiver_io_tpu.training.scaling import bootstrap_exponents
+
+    for flops, params, tokens in ([1e12], [1e6], [1e9]), ([1e12, 1e12], [1e6, 2e6], [1e9, 2e9]):
+        cis = bootstrap_exponents(flops, params, tokens, n_boot=50, seed=0)
+        assert cis["a_ci95"] is None and cis["b_ci95"] is None
+        assert cis["n_boot_effective"] == 0
+        assert "unidentifiable" in cis["note"]
+
+
 def test_refit_reports_identification(tmp_path):
     """refit() on synthetic two-run CSVs: records law_free + CIs and counts
     interior points only where ranges genuinely overlap."""

@@ -164,8 +164,10 @@ def test_overlapping_same_pitch_fifo_pairing():
 def test_malformed_inputs_raise():
     with pytest.raises(ValueError, match="MThd"):
         parse_smf(b"RIFFxxxx")
-    with pytest.raises(ValueError, match="MTrk"):
-        parse_smf(_header(0, 1, 100) + b"\x00\x01\x02\x03" + struct.pack(">I", 0))
+    # a non-MTrk chunk — even with a non-alphanumeric tag — is SKIPPED per
+    # spec (advisor r4), so a file with no MTrk parses to an empty score
+    empty = parse_smf(_header(0, 1, 100) + b"\x00\x01\x02\x03" + struct.pack(">I", 0))
+    assert empty.notes == []
     # truncated mid-event and short-header files raise clean ValueErrors, never
     # raw IndexError/struct.error (the pipeline calls read_smf directly)
     with pytest.raises(ValueError, match="truncated"):
@@ -183,11 +185,21 @@ def test_read_smf_names_the_file(tmp_path):
 
 def test_alien_chunks_skipped():
     """Vendor chunks (e.g. Yamaha XF) between tracks are skipped per spec, not
-    fatal — files the pretty_midi path ingested must keep loading."""
+    fatal — files the pretty_midi path ingested must keep loading. The spec
+    allows ANY 4-byte tag, including spaces and punctuation (advisor r4), so
+    only a declared length that overruns the file is malformed."""
     payload = bytes([0x00, 0x90, 60, 64, 0x64, 0x80, 60, 0, 0x00, 0xFF, 0x2F, 0x00])
     alien = b"XFIH" + struct.pack(">I", 5) + b"\x01\x02\x03\x04\x05"
     smf = parse_smf(_header(0, 1, 100) + alien + _track(payload))
     assert len(smf.notes) == 1
+
+    punct = b"X! \x7f" + struct.pack(">I", 3) + b"abc"  # legal tag, skipped by length
+    smf = parse_smf(_header(0, 1, 100) + punct + _track(payload))
+    assert len(smf.notes) == 1
+
+    overrun = b"XFIH" + struct.pack(">I", 999) + b"\x01"
+    with pytest.raises(ValueError, match="declares 999 bytes"):
+        parse_smf(_header(0, 1, 100) + overrun + _track(payload))
 
 
 def test_chord_note_order_roundtrip(tmp_path):

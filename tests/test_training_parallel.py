@@ -204,6 +204,21 @@ def test_param_sharding_rules():
     assert p["cross_attn"]["attention"]["q_proj"]["kernel"].spec == jax.sharding.PartitionSpec("fsdp", "tensor")
 
 
+def test_constrain_batch_sharded_ragged_batch():
+    """A batch the data axes cannot divide (e.g. a ragged final eval batch)
+    must not FAIL the propagation hint — constrain_batch_sharded skips the
+    constraint and the program runs as it did before the hint existed; the
+    hint still pins divisible batches (advisor r4 finding)."""
+    from perceiver_io_tpu.parallel.mesh import constrain_batch_sharded, make_mesh
+
+    mesh = make_mesh({"data": 2, "fsdp": 4})  # data-axis product 8
+    with jax.sharding.set_mesh(mesh):
+        ragged = jax.jit(constrain_batch_sharded)(jnp.ones((6, 8)))  # 6 % 8 != 0
+        np.testing.assert_array_equal(np.asarray(ragged), np.ones((6, 8)))
+        even = jax.jit(constrain_batch_sharded)(jnp.ones((8, 8)))
+        assert not even.sharding.is_fully_replicated  # hint intact on the common case
+
+
 def test_create_sharded_train_state_matches_host_init():
     """Jitted init with out_shardings must produce the same params and the same
     loss trajectory as host init + device_put (shard_train_state)."""
