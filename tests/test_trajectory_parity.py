@@ -1,0 +1,140 @@
+"""Golden TRAINING-trajectory parity vs the torch reference (VERDICT r4 item 2).
+
+Forward goldens (test_convert_torch.py) pin logits; this pins the remaining
+unverified contract — the full training semantics: label shift + -100 masking,
+CE-over-latents, AdamW, cosine-with-warmup scheduling, and global-norm
+clipping — by running 10 optimizer steps in BOTH frameworks from the same torch
+initialization on identical batches and requiring the per-step loss
+trajectories to match. A loss match at step k proves the parameter states after
+step k-1 agree, so the whole optimizer chain is pinned transitively.
+
+Reference semantics:
+  step/loss   /root/reference/perceiver/model/core/lightning.py:117-133
+  schedule    /root/reference/perceiver/scripts/lrs.py:7-28 (imported directly
+              and run as the torch side's LambdaLR)
+  optimizer   torch.optim.AdamW as configured via the CLM CLI; clipping is the
+              FSDP script's manual clip_grad_norm_ (scripts/text/clm_fsdp.py)
+"""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from perceiver_io_tpu.hf import convert_torch as ct  # noqa: E402
+from tests.reference_stub import import_reference  # noqa: E402
+
+import_reference()
+
+from perceiver.model.core.config import CausalSequenceModelConfig as RefCSMConfig  # noqa: E402
+from perceiver.model.core.modules import CausalSequenceModel as RefCSM  # noqa: E402
+
+STEPS, WARMUP, LR, WD, CLIP = 10, 3, 3e-3, 0.01, 1.0
+
+
+def _ref_cosine_lr_cls():
+    # perceiver.scripts.__init__ imports datasets/s3fs (absent here); lrs.py
+    # itself depends only on torch, so load it directly by path
+    spec = importlib.util.spec_from_file_location(
+        "reference_lrs", "/root/reference/perceiver/scripts/lrs.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.CosineWithWarmupLR
+
+
+def _batches():
+    """Deterministic batches, odd steps carrying a pad mask so the -100 ignore
+    path is part of the pinned trajectory. Tokens are drawn from 1..8 inside
+    the 50-token vocab: uniform-over-vocab data would sit AT the entropy floor
+    (nothing learnable), whereas a low-entropy marginal gives the optimizers a
+    real descent direction so the trajectories are non-trivial."""
+    rs = np.random.RandomState(42)
+    batches = []
+    for i in range(STEPS):
+        x = rs.randint(1, 9, (4, 12))
+        pad = np.zeros((4, 12), bool)
+        if i % 2:
+            # pads must land INSIDE the latent window (the last max_latents=6
+            # positions): the loss slices labels[:, prefix_len:], so only
+            # there do the -100 labels actually flow into the CE reduction
+            pad[0, -2:] = True
+            x[pad] = 0
+        batches.append((x, pad))
+    return batches
+
+
+def test_training_trajectory_parity():
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+    from perceiver_io_tpu.training.lrs import cosine_with_warmup
+    from perceiver_io_tpu.training.trainer import TrainState, build_optimizer, make_causal_lm_train_step
+
+    kwargs = dict(
+        vocab_size=50, max_seq_len=12, max_latents=6, num_channels=16, num_heads=2,
+        num_self_attention_layers=2, cross_attention_dropout=0.0, abs_pos_emb=True,
+        output_norm=True, output_bias=True, num_self_attention_rotary_layers=1,
+    )
+    torch.manual_seed(0)  # reproducible init: the drift/descent bounds below
+    # were validated against THIS trajectory, not whatever the global RNG holds
+    ref = RefCSM(RefCSMConfig(**kwargs)).train()
+    cfg = CausalSequenceModelConfig(**kwargs)
+    model = CausalSequenceModel(config=cfg, deterministic=True)
+    # convert the INITIAL torch state before the torch loop mutates it
+    params = ct.causal_sequence_model_params(
+        {k: v.clone() for k, v in ref.state_dict().items()}, cfg
+    )
+
+    batches = _batches()
+
+    # ---- torch trajectory: the reference Lightning step inlined (the Lit
+    # class itself is import-stubbed in tests), lightning.py:117-133
+    opt = torch.optim.AdamW(ref.parameters(), lr=LR, betas=(0.9, 0.999), eps=1e-8, weight_decay=WD)
+    sched = _ref_cosine_lr_cls()(opt, training_steps=STEPS, warmup_steps=WARMUP)
+    ce = torch.nn.CrossEntropyLoss()  # ignore_index=-100 default
+    ref_losses, ref_lrs = [], []
+    for x, pad in batches:
+        xt, padt = torch.tensor(x), torch.tensor(pad)
+        labels = torch.roll(xt, -1, 1)
+        labels[padt] = -100
+        logits = ref(xt, prefix_len=12 - 6, pad_mask=padt).logits
+        l = labels[:, -logits.shape[1]:]
+        loss = ce(logits.reshape(-1, logits.shape[-1]), l.reshape(-1))
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(ref.parameters(), CLIP)
+        ref_lrs.append(opt.param_groups[0]["lr"])
+        opt.step()
+        sched.step()
+        ref_losses.append(float(loss.detach()))
+
+    # ---- jax trajectory through the production train step + optimizer factory
+    schedule = cosine_with_warmup(LR, training_steps=STEPS, warmup_steps=WARMUP)
+    tx = build_optimizer(schedule, weight_decay=WD, max_grad_norm=CLIP)
+    state = TrainState.create(params, tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=6))
+    my_losses = []
+    for x, pad in batches:
+        batch = {
+            "input_ids": jnp.asarray(x),
+            "labels": jnp.asarray(np.roll(x, -1, 1)),
+            "pad_mask": jnp.asarray(pad),
+        }
+        state, metrics = step(state, batch)
+        my_losses.append(float(metrics["loss"]))
+
+    # the schedule function itself must agree with the torch LambdaLR at every
+    # applied step (warmup ramp from 0, cosine tail)
+    np.testing.assert_allclose(
+        [float(schedule(k)) for k in range(STEPS)], ref_lrs, rtol=1e-6, atol=1e-9
+    )
+    # per-step losses: float32 in both frameworks; drift after 10 coupled
+    # optimizer steps stays well under this
+    np.testing.assert_allclose(my_losses, ref_losses, rtol=2e-4, atol=2e-4)
+    # the trajectory must actually descend (guards against a vacuously-flat run)
+    assert my_losses[-1] < my_losses[0] - 0.05
