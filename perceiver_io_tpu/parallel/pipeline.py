@@ -24,14 +24,22 @@ identical to the non-pipelined model — correctness is pinned by equivalence
 tests against the single-device forward/backward in
 ``tests/test_pipeline_parallel.py``.
 
-Composition (v1): ``pipe`` composes with the ``data`` batch axis (microbatches
-are per-data-shard) and leaves ``fsdp``/``tensor``/``seq`` alone — a mesh that
-sets ``pipe`` together with a >1 ``fsdp``/``tensor``/``seq`` axis is rejected
-rather than silently resharded every tick.
+Composition (v2): ``pipe`` composes with the ``data`` batch axis (microbatches
+are per-data-shard) AND with ``fsdp`` — each stage's stacked params stay
+ZeRO-3-sharded over the fsdp axis at rest and are all-gathered ONE LAYER AT A
+TIME inside the stage's scan (under the remat boundary, so the backward pass
+regathers instead of saving gathered layers); the all-gather's transpose is a
+reduce-scatter, which is exactly ZeRO-3's gradient flow. The fsdp axis also
+carries a batch shard (it is a data axis, parallel/mesh.py DATA_AXES), matching
+the non-pipelined fsdp path. Without fsdp×pipe a pipeline cannot serve the
+455M-class models PP exists for (the reference's flagship path is FSDP,
+scripts/text/clm_fsdp.py:24-36). ``tensor``/``seq`` with ``pipe`` remain
+rejected rather than silently resharded every tick.
 """
 
 from __future__ import annotations
 
+from functools import reduce
 from typing import Callable, Optional
 
 import jax
@@ -41,7 +49,7 @@ from jax.sharding import PartitionSpec as P
 from perceiver_io_tpu.parallel.mesh import DATA_AXES
 from perceiver_io_tpu.parallel.ring_attention import _shard_map
 
-_INCOMPATIBLE_AXES = ("fsdp", "tensor", "seq")
+_INCOMPATIBLE_AXES = ("tensor", "seq")
 
 
 def pipeline_mesh_plan(pipe_axis: str = "pipe"):
@@ -59,7 +67,7 @@ def pipeline_mesh_plan(pipe_axis: str = "pipe"):
     if bad:
         raise ValueError(
             f"pipeline axis '{pipe_axis}' cannot combine with sharded {bad} axes "
-            "(v1 composes pipe with the data axis only)"
+            "(pipe composes with data/fsdp only)"
         )
     baxes = tuple(a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
     return size, baxes
@@ -114,12 +122,42 @@ def pipeline_layer_stack(
             f"not divisible by num_microbatches ({M})"
         )
 
-    layer_fn = layer_apply
+    has_fsdp = mesh is not None and "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1
+    if has_fsdp:
+        from perceiver_io_tpu.parallel.sharding import stacked_param_specs
+
+        # per-leaf P(pipe, ..fsdp..): params enter the region still ZeRO-3
+        # sharded; _gatherers reconstructs ONE layer at a time inside the scan.
+        # min_fsdp_size=1 pins the region view to always-sharded: when the
+        # at-rest param is replicated (below the train state's size floor) the
+        # entry reshard is a free local slice, whereas the opposite mismatch
+        # would all-gather a whole stage's params at region entry
+        pspec = stacked_param_specs(stacked_params, mesh, pipe_axis, min_fsdp_size=1)
+    else:
+        pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    # one all-gather closure per leaf (leaf-dim indices are per-LAYER, hence the
+    # -1 offset from the stacked spec); a leaf with no fsdp dim passes through
+    _gatherers = jax.tree.map(
+        lambda spec: (
+            lambda v, dims=tuple(i - 1 for i, a in enumerate(spec) if a == "fsdp"): reduce(
+                lambda u, d: jax.lax.all_gather(u, "fsdp", axis=d, tiled=True), dims, v
+            )
+        ),
+        pspec,
+    )
+
+    def layer_gathered(p, rng, h, gate, *ex):
+        if has_fsdp:
+            p = jax.tree.map(lambda v, g: g(v), p, _gatherers)
+        return layer_apply(p, rng, h, gate, *ex)
+
+    layer_fn = layer_gathered
     if remat:
-        layer_fn = jax.checkpoint(layer_apply, policy=remat_policy)
+        # gather INSIDE the checkpoint: the backward pass regathers the layer
+        # (ZeRO-3 semantics) instead of saving the gathered full-size params
+        layer_fn = jax.checkpoint(layer_gathered, policy=remat_policy)
 
     has_keys = dropout_keys is not None
-    pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     bspec = P(batch_axes if batch_axes else None)
 
     def local_fn(params_local, x_full, gates_local, keys_local, *extra_local):

@@ -129,6 +129,25 @@ def infer_param_shardings(params, mesh: Mesh, min_fsdp_size: int = 2**12, pipeli
     return jax.tree_util.tree_map_with_path(f, params)
 
 
+def stacked_param_specs(stacked_params, mesh, pipe_axis: str, min_fsdp_size: int = 2**12):
+    """PartitionSpecs for the scanned layer stack, used as the pipeline
+    shard_map's param in_specs (parallel/pipeline.py): the leading layer axis
+    shards over ``pipe_axis`` and the remaining dims follow the SAME fsdp rule
+    ``infer_param_shardings`` applies to scanned params (shared ``_spec_for``
+    with the layer axis excluded), so the pipeline region's view of the params
+    cannot drift from the train state's at-rest shardings. ``mesh`` may be an
+    AbstractMesh (trace-time ambient mesh)."""
+
+    def f(path, v):
+        keys = (SCAN_MODULE_NAME,) + tuple(getattr(k, "key", str(k)) for k in path)
+        spec = _spec_for(keys, v, mesh, min_fsdp_size, exclude_dims=(0,))
+        axes = list(spec) + [None] * (np.ndim(v) - len(spec))
+        axes[0] = pipe_axis
+        return PartitionSpec(*axes)
+
+    return jax.tree_util.tree_map_with_path(f, stacked_params)
+
+
 def replicated_shardings(params, mesh: Mesh):
     """Pure data parallelism: replicate everything (the reference's DDP)."""
     rep = NamedSharding(mesh, PartitionSpec())
