@@ -154,16 +154,29 @@ def refit(out_dir: str) -> dict:
     law_assumed = fit_scaling_law(*cols)
     law_free = fit_scaling_law_free(*cols)
     cis = bootstrap_exponents(*cols)
+
+    def _in_ci(ci, x):
+        return None if ci is None else bool(ci[0] <= x <= ci[1])
+
     result = {
         "frontier": frontier,
-        # coefficients under ASSUMED C^0.5 exponents (Chinchilla Approach-2 style)
-        "law": {"a": law_assumed.a, "b": law_assumed.b, "k_n": law_assumed.k_n, "k_d": law_assumed.k_d},
-        "law_str": str(law_assumed),
-        # exponents FITTED from the frontier (Approach-1 style) + bootstrap CIs:
-        # the honest headline, with its uncertainty stated
+        # THE HEADLINE: exponents FITTED from the frontier (Approach-1 style),
+        # uncertainty stated via the bootstrap CIs below
         "law_free": {"a": law_free.a, "b": law_free.b, "k_n": law_free.k_n, "k_d": law_free.k_d},
         "law_free_str": str(law_free),
         "exponent_ci95": cis,
+        # coefficients under ASSUMED C^0.5 exponents (Chinchilla Approach-2
+        # style): a PRIOR from the literature, not a finding of this study —
+        # prior_supported_by_data records whether each assumed exponent falls
+        # inside the free fit's bootstrap CI (VERDICT r4 weak #4: the earlier
+        # artifact headlined this law while its own bootstrap rejected b=0.5)
+        "law_assumed_prior": {"a": law_assumed.a, "b": law_assumed.b,
+                              "k_n": law_assumed.k_n, "k_d": law_assumed.k_d},
+        "law_assumed_prior_str": str(law_assumed),
+        "prior_supported_by_data": {
+            "a_0.5_in_ci95": _in_ci(cis.get("a_ci95"), law_assumed.a),
+            "b_0.5_in_ci95": _in_ci(cis.get("b_ci95"), law_assumed.b),
+        },
         "interior_points": interior,
         "n_interior_points": len(interior),
         "identification_note": (
@@ -203,10 +216,13 @@ def _write_readme(out_dir: str, runs: list) -> None:
         "python -m perceiver_io_tpu.scripts.scaling_study --refit convergence/scaling",
         "```",
         "",
-        "`law.json` records BOTH fits: `law` (coefficients under assumed C^0.5",
-        "exponents, Chinchilla Approach-2 style) and `law_free` (exponents",
-        "estimated from the frontier, with bootstrap 95% CIs in",
-        "`exponent_ci95`). `interior_points` lists the frontier points that",
+        "`law.json` leads with `law_free` — exponents estimated from the",
+        "frontier, with bootstrap 95% CIs in `exponent_ci95`; that is the",
+        "study's finding. `law_assumed_prior` (coefficients under assumed",
+        "C^0.5 exponents, Chinchilla Approach-2 style) is a literature PRIOR,",
+        "kept for comparison; `prior_supported_by_data` records whether each",
+        "assumed exponent falls inside the free fit's CI.",
+        "`interior_points` lists the frontier points that",
         "actually identify the exponent — budgets where a smaller model beats",
         "larger ones whose observed FLOPs range also covers the budget; all",
         "other frontier points are range-endpoint artifacts and budgets beyond",
