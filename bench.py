@@ -122,59 +122,62 @@ def bench_clm_30m():
                              metric="perceiver_ar_clm_30m_train_tokens_per_sec_per_chip")
 
 
+def clm_8k_bench_config(scan_unroll: int = 1):
+    """The Perceiver AR paper's 8k long-context regime on the 30M-class
+    architecture. Shared by the bench task and scripts/xla_cost_proxy.py so the
+    measured workload and the FLOPs-accounting workload cannot drift."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+
+    return CausalSequenceModelConfig(
+        vocab_size=262, max_seq_len=8192, max_latents=1024, num_channels=512,
+        num_heads=8, num_self_attention_layers=8, cross_attention_dropout=0.5,
+        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
+        fused_qkv=True, scan_unroll=scan_unroll,
+    )
+
+
+def decode_bench_config(scan_unroll: int = 1):
+    """The decode-serving 30M-class shape (NOTES.md); shared with the proxy."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+
+    return CausalSequenceModelConfig(
+        vocab_size=262, max_seq_len=4096, max_latents=512, num_channels=512,
+        num_heads=8, num_self_attention_layers=8, scan_unroll=scan_unroll,
+    )
+
+
 def bench_clm_8k():
     """Long-context single-chip training: the Perceiver AR paper's 8k regime
     (seq 8192, 1024 latents) on the 30M-class architecture — latent compression
     is what keeps 8k-context training feasible on ONE chip (NOTES.md measured
     139k latent tokens/s / 15.6% MFU); contexts beyond one chip's HBM use ring
     attention (sequence_parallel_axis) instead."""
-    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
-
-    config = CausalSequenceModelConfig(
-        vocab_size=262, max_seq_len=8192, max_latents=1024, num_channels=512,
-        num_heads=8, num_self_attention_layers=8, cross_attention_dropout=0.5,
-        activation_checkpointing=True, remat_policy="dots_with_no_batch_dims_saveable",
-        fused_qkv=True,
-    )
-    return _bench_clm_config(config, batch_size=4, n_steps=5,
+    return _bench_clm_config(clm_8k_bench_config(), batch_size=4, n_steps=5,
                              metric="perceiver_ar_clm_8k_longcontext_train_tokens_per_sec_per_chip")
 
 
 # Fixed external target for the optical-flow task (BASELINE.json north star:
 # "Perceiver IO optical-flow inference matching A100 frames/sec on v5e-8").
-# The compiled forward costs 4.659 TFLOP per Sintel frame pair (XLA
-# cost_analysis of the 41M model on all six 368x496 patches). An A100
+# The compiled forward costs 11.449 TFLOP per Sintel frame pair (XLA
+# cost_analysis of the 41M model on all six 368x496 patches with the 24-layer
+# SA scan UNROLLED — scripts/xla_cost_proxy.py; the round-2 figure of 4.659
+# TFLOP came from a rolled scan, whose body cost_analysis counts only once,
+# so it understated the workload and overstated the A100 target). An A100
 # (312 TFLOP/s dense bf16 peak) running that workload at the suite-wide 40%-MFU
-# north star sustains 312e12 * 0.40 / 4.659e12 = 26.8 frame-pairs/s; matching
-# it across a v5e-8 slice means each chip must deliver 26.8 / 8 = 3.35
+# north star sustains 312e12 * 0.40 / 11.449e12 = 10.9 frame-pairs/s; matching
+# it across a v5e-8 slice means each chip must deliver 10.9 / 8 = 1.36
 # frame-pairs/s. vs_baseline = measured fps / this target.
-_OF_FLOPS_PER_FRAME_PAIR = 4.659e12
+_OF_FLOPS_PER_FRAME_PAIR = 11.449e12
 _OF_TARGET_FPS_PER_CHIP = 312e12 * 0.40 / _OF_FLOPS_PER_FRAME_PAIR / 8
 
 
 def bench_optical_flow():
     from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
-    from perceiver_io_tpu.models.vision.optical_flow import (
-        OpticalFlow,
-        OpticalFlowConfig,
-        OpticalFlowDecoderConfig,
-        OpticalFlowEncoderConfig,
-    )
+    from perceiver_io_tpu.models.vision.optical_flow import OpticalFlow, official_41m_config
 
     # official deepmind/optical-flow-perceiver dims (reference
     # vision/optical_flow/huggingface.py; 41M params)
-    enc = OpticalFlowEncoderConfig(
-        image_shape=(368, 496), num_patch_input_channels=27,
-        num_patch_hidden_channels=64, num_frequency_bands=64,
-        num_cross_attention_heads=1, num_self_attention_heads=8,
-        num_self_attention_layers_per_block=24, num_self_attention_blocks=1,
-    )
-    dec = OpticalFlowDecoderConfig(
-        image_shape=(368, 496), num_cross_attention_qk_channels=512,
-        num_cross_attention_v_channels=512, num_cross_attention_heads=1,
-        cross_attention_residual=False,
-    )
-    cfg = OpticalFlowConfig(encoder=enc, decoder=dec, num_latents=2048, num_latent_channels=512)
+    cfg = official_41m_config()
     model = OpticalFlow(config=cfg, dtype=jnp.bfloat16)
 
     rng = jax.random.PRNGKey(0)
@@ -218,13 +221,9 @@ def bench_decode():
     import os
 
     from perceiver_io_tpu.generation.generate import GenerationConfig, generate
-    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
     from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
 
-    config = CausalSequenceModelConfig(
-        vocab_size=262, max_seq_len=4096, max_latents=512, num_channels=512,
-        num_heads=8, num_self_attention_layers=8,
-    )
+    config = decode_bench_config()
     model = CausalSequenceModel(config=config, dtype=jnp.bfloat16)
     b, prompt_len, new_tokens = 8, 2048, 512
     rng = jax.random.PRNGKey(0)

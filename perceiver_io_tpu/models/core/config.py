@@ -30,6 +30,12 @@ class EncoderConfig:
     dropout: float = 0.0
     init_scale: float = 0.02
     freeze: bool = False
+    # lax.scan unroll factor for the SA-block layer loop — the same TPU
+    # execution knob CausalSequenceModelConfig.scan_unroll exposes (NOTES.md:
+    # full unroll is +2.9 MFU points on the 455M CLM; rolled wins at small op
+    # sizes). Also required for exact XLA cost accounting: cost_analysis counts
+    # a rolled scan body ONCE (scripts/xla_cost_proxy.py).
+    scan_unroll: int = 1
 
     def base_kwargs(self, exclude=("freeze",)):
         return _base_kwargs(self, EncoderConfig, exclude)

@@ -581,6 +581,7 @@ class PerceiverEncoder(nn.Module):
     activation_checkpointing: bool = False
     remat_policy: Optional[str] = None  # jax.checkpoint_policies name (None = full remat)
     activation_offloading: bool = False  # stage checkpointed dots to pinned host (see _remat_policy)
+    scan_unroll: int = 1  # SA-block layer-loop unroll (see EncoderConfig.scan_unroll)
     deterministic: bool = True
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
@@ -645,6 +646,7 @@ class PerceiverEncoder(nn.Module):
                 activation_checkpointing=self.activation_checkpointing,
                 remat_policy=self.remat_policy,
                 activation_offloading=self.activation_offloading,
+                scan_unroll=self.scan_unroll,
                 init_scale=self.init_scale,
                 deterministic=self.deterministic,
                 dtype=self.dtype,

@@ -3,4 +3,5 @@ from perceiver_io_tpu.models.vision.optical_flow.backend import (
     OpticalFlowConfig,
     OpticalFlowDecoderConfig,
     OpticalFlowEncoderConfig,
+    official_41m_config,
 )

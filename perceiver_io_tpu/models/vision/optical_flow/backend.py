@@ -186,3 +186,23 @@ class OpticalFlow(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         x_latent, x_adapted = self.encoder(x, return_adapted_input=True)
         return self.decoder(x_latent, x_adapted=x_adapted)
+
+
+def official_41m_config(scan_unroll: int = 1) -> OpticalFlowConfig:
+    """The official deepmind/optical-flow-perceiver dims (41M params; reference
+    vision/optical_flow/huggingface.py model card). Shared by bench.py's
+    optical-flow task and scripts/xla_cost_proxy.py so the measured workload
+    and the FLOPs-accounting workload cannot drift."""
+    enc = OpticalFlowEncoderConfig(
+        image_shape=(368, 496), num_patch_input_channels=27,
+        num_patch_hidden_channels=64, num_frequency_bands=64,
+        num_cross_attention_heads=1, num_self_attention_heads=8,
+        num_self_attention_layers_per_block=24, num_self_attention_blocks=1,
+        scan_unroll=scan_unroll,
+    )
+    dec = OpticalFlowDecoderConfig(
+        image_shape=(368, 496), num_cross_attention_qk_channels=512,
+        num_cross_attention_v_channels=512, num_cross_attention_heads=1,
+        cross_attention_residual=False,
+    )
+    return OpticalFlowConfig(encoder=enc, decoder=dec, num_latents=2048, num_latent_channels=512)
