@@ -163,3 +163,142 @@ class SyntheticDigitsDataModule(MNISTDataModule):
         tf_valid = lambda im: mnist_transform(im, self.normalize, self.channels_last, None, center_crop=self.random_crop)
         self.ds_train = _MnistSplit(tr_images, tr_labels, tf_train)
         self.ds_valid = _MnistSplit(va_images, va_labels, tf_valid)
+
+
+# --------------------------------------------------------------------------
+# Synthetic optical flow: textured frame pairs with analytically-known dense
+# flow (rigid translation + small rotation about the image center). The
+# reference ships converted official flow weights and never trains flow
+# in-repo; this is the zero-egress path to a task-level QUALITY number for the
+# optical-flow pipeline (VERDICT r4 item 7): train a small OpticalFlow model
+# on pairs whose ground truth is exact, then report endpoint error through the
+# FULL pipeline (patching -> model -> blending, data/vision/optical_flow.py)
+# against the zero-flow trivial baseline.
+
+
+def _smooth_texture(rng: np.random.Generator, h: int, w: int, octaves=(4, 8, 16)) -> np.ndarray:
+    """(h, w, 3) uint8 multi-scale smooth noise: locally matchable structure
+    at several spatial frequencies (a flat or white-noise image would make the
+    correspondence problem degenerate or aliased)."""
+    img = np.zeros((h, w, 3), np.float32)
+    for cells in octaves:
+        coarse = rng.normal(size=(cells + 1, cells + 1, 3)).astype(np.float32)
+        ys = np.linspace(0, cells, h)
+        xs = np.linspace(0, cells, w)
+        y0 = np.minimum(ys.astype(int), cells - 1)
+        x0 = np.minimum(xs.astype(int), cells - 1)
+        fy = (ys - y0)[:, None, None]
+        fx = (xs - x0)[None, :, None]
+        c00 = coarse[y0][:, x0]
+        c01 = coarse[y0][:, x0 + 1]
+        c10 = coarse[y0 + 1][:, x0]
+        c11 = coarse[y0 + 1][:, x0 + 1]
+        img += (1 - fy) * ((1 - fx) * c00 + fx * c01) + fy * ((1 - fx) * c10 + fx * c11)
+    img -= img.min()
+    img /= max(img.max(), 1e-6)
+    return (img * 255).astype(np.uint8)
+
+
+def _bilinear_sample(canvas: np.ndarray, ys: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Sample (H, W, C) canvas at float coords (h, w) arrays -> (h, w, C)."""
+    h_max, w_max = canvas.shape[0] - 2, canvas.shape[1] - 2
+    ys = np.clip(ys, 0, h_max)
+    xs = np.clip(xs, 0, w_max)
+    y0 = ys.astype(int)
+    x0 = xs.astype(int)
+    fy = (ys - y0)[..., None]
+    fx = (xs - x0)[..., None]
+    c00 = canvas[y0, x0]
+    c01 = canvas[y0, x0 + 1]
+    c10 = canvas[y0 + 1, x0]
+    c11 = canvas[y0 + 1, x0 + 1]
+    return (1 - fy) * ((1 - fx) * c00 + fx * c01) + fy * ((1 - fx) * c10 + fx * c11)
+
+
+def make_flow_pair(
+    rng: np.random.Generator,
+    img_shape: tuple,
+    max_shift: float = 3.0,
+    max_rot_deg: float = 2.0,
+):
+    """One (frame1, frame2, flow) triple under a rigid motion x' = R(x-c)+c+t.
+
+    frame2 is rendered by sampling frame1's larger canvas under the INVERSE
+    warp, so the forward flow field flow(x) = (R-I)(x-c) + t is EXACT at every
+    pixel (no border invention: the canvas margin covers the displacement)."""
+    h, w = img_shape
+    t = rng.uniform(-max_shift, max_shift, size=2)  # (dy, dx)
+    ang = np.deg2rad(rng.uniform(-max_rot_deg, max_rot_deg))
+    corner = max(h, w) / 2 * abs(ang)  # max extra displacement from rotation
+    margin = int(np.ceil(max_shift + corner)) + 2
+    canvas = _smooth_texture(rng, h + 2 * margin, w + 2 * margin)
+
+    frame1 = canvas[margin : margin + h, margin : margin + w].copy()
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    yy, xx = np.meshgrid(np.arange(h, dtype=np.float32), np.arange(w, dtype=np.float32), indexing="ij")
+    cos, sin = np.cos(ang), np.sin(ang)
+    # forward flow at frame1 pixels: (R - I)(x - c) + t
+    dy = (cos - 1) * (yy - cy) - sin * (xx - cx) + t[0]
+    dx = sin * (yy - cy) + (cos - 1) * (xx - cx) + t[1]
+    flow = np.stack([dx, dy], axis=-1).astype(np.float32)  # (H, W, 2) as (u=dx, v=dy)
+
+    # inverse warp for frame2: frame2(y) = frame1(R^-1 (y - c - t) + c)
+    src_y = cos * (yy - cy - t[0]) + sin * (xx - cx - t[1]) + cy
+    src_x = -sin * (yy - cy - t[0]) + cos * (xx - cx - t[1]) + cx
+    frame2 = _bilinear_sample(canvas.astype(np.float32), src_y + margin, src_x + margin)
+    return frame1, frame2.astype(np.uint8), flow
+
+
+@dataclass
+class SyntheticFlowDataModule:
+    """Patch-sized training pairs (preprocessed to the 27-channel neighborhood
+    stack) + dense ground-truth flow; the model learns flow / flow_scale_factor
+    exactly as the pipeline's postprocess assumes (optical_flow.py:127)."""
+
+    image_shape: tuple = (32, 48)
+    batch_size: int = 16
+    n_train: int = 1536
+    n_val: int = 128
+    max_shift: float = 3.0
+    max_rot_deg: float = 2.0
+    flow_scale_factor: int = 20
+    seed: int = 0
+
+    def setup(self) -> None:
+        from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
+
+        proc = OpticalFlowProcessor(patch_size=self.image_shape, patch_min_overlap=8,
+                                    flow_scale_factor=self.flow_scale_factor)
+        rng = np.random.default_rng(self.seed)
+
+        def build(n):
+            xs = np.empty((n, 2, 27, *self.image_shape), np.float32)
+            flows = np.empty((n, *self.image_shape, 2), np.float32)
+            for i in range(n):
+                f1, f2, flow = make_flow_pair(rng, self.image_shape, self.max_shift, self.max_rot_deg)
+                xs[i] = proc.preprocess((f1, f2))[0]  # patch-sized: exactly one patch
+                flows[i] = flow
+            return xs, flows
+
+        self._train = build(self.n_train)
+        self._val = build(self.n_val)
+
+    def _loader(self, split, shuffle_seed=None):
+        xs, flows = split
+
+        def gen():
+            idx = np.arange(len(xs))
+            if shuffle_seed is not None:
+                np.random.default_rng(shuffle_seed).shuffle(idx)
+            for i in range(0, len(idx) - self.batch_size + 1, self.batch_size):
+                j = idx[i : i + self.batch_size]
+                yield {"x": xs[j], "flow": flows[j]}
+
+        return gen()
+
+    def train_dataloader(self):
+        self._epoch = getattr(self, "_epoch", 0) + 1
+        return self._loader(self._train, shuffle_seed=self.seed + self._epoch)
+
+    def val_dataloader(self):
+        return self._loader(self._val)

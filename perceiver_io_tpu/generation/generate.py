@@ -50,6 +50,11 @@ class GenerationConfig:
     # is exactly the token-by-token greedy sequence; only the iteration count
     # changes. Requires greedy sampling with no EOS (see generate()).
     decode_chunk: int = 1
+    # First-iteration drafts: repeat the prompt's last token (a repetition
+    # prior) vs pad tokens. Output-invariant either way — only accept_rate
+    # moves — so the knob exists purely for the measure-or-revert A/B
+    # (scripts/decode_sweep.py, VERDICT r4 item 3).
+    seed_drafts_from_prompt: bool = True
 
 
 def _validate(model, seq_len: int, num_latents: int) -> int:
@@ -145,8 +150,12 @@ def _generate_chunked(model, params, input_ids, pad_mask, rng, *, prefix_len: in
     emitted0 = jnp.zeros((), jnp.int32)
     iters0 = jnp.zeros((), jnp.int32)
     # first drafts: repeat the prompt's last token — a free repetition prior
-    # that only affects acceptance (how many drafts verify), never the output
-    guesses0 = jnp.broadcast_to(input_ids[:, -1:].astype(jnp.int32), (b, n - 1))
+    # that only affects acceptance (how many drafts verify), never the output.
+    # seed_drafts_from_prompt=False uses pad tokens instead (the A/B arm)
+    if config.seed_drafts_from_prompt:
+        guesses0 = jnp.broadcast_to(input_ids[:, -1:].astype(jnp.int32), (b, n - 1))
+    else:
+        guesses0 = jnp.full((b, n - 1), config.pad_token_id, jnp.int32)
 
     def chunk_cond(carry):
         return carry[0] + n <= k_chunk  # a full chunk still fits the no-roll budget

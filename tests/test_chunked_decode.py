@@ -109,6 +109,11 @@ def test_chunked_generate_equals_token_by_token(setup):
     # phase, and the chunk phase commits >= 1 token per iteration
     assert stats["chunked_tokens"] + stats["tail_steps"] == 16
     assert 1 <= stats["chunk_iterations"] <= stats["chunked_tokens"] <= 4  # k_chunk = 4 here
+    # the draft-seeding knob is OUTPUT-invariant (it only moves accept_rate):
+    # pad-seeded first drafts must emit the identical greedy chain
+    unseeded = generate(model, params, prompt, num_latents=4, max_new_tokens=16,
+                        decode_chunk=4, seed_drafts_from_prompt=False)
+    np.testing.assert_array_equal(np.asarray(unseeded), np.asarray(seq))
 
 
 def test_chunk_larger_than_headroom_still_exact(setup):

@@ -184,6 +184,61 @@ def test_full_model_decode_with_kernel_matches_plain(monkeypatch):
     np.testing.assert_allclose(fused, plain, atol=2e-5)
 
 
+@pytest.mark.slow  # full-model interpret-kernel run x2; the default tier keeps
+# ragged coverage via the cheap per-batch-position kernel tests above
+def test_full_model_ragged_prompts_with_kernel_matches_plain(monkeypatch):
+    """RAGGED prompts (per-batch lengths via LEFT padding — the reference's
+    batched-generate convention, core/huggingface.py:89-156) through the fused
+    kernel: per-batch pad slots and rope angles stream through the kernel's
+    (B,)-scalar-prefetch path, and both single-token and n_q=4 chunked decode
+    logits must match the kernel-off formulation (NOTES r2 item 3 /
+    VERDICT r4 item 3's ragged-length kernel coverage)."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+    cfg = CausalSequenceModelConfig(
+        vocab_size=50, max_seq_len=16, max_latents=8, num_channels=32, num_heads=2,
+        num_self_attention_layers=2, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=cfg)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (2, 12), 1, 50)
+    # row 0 holds an 8-token prompt (4 left pads), row 1 a full 12-token one
+    pad = np.zeros((2, 12), bool)
+    pad[0, :4] = True
+    x = jnp.asarray(np.where(pad, 0, np.asarray(x)))
+    pad = jnp.asarray(pad)
+    params = model.init(rng, x, prefix_len=4)
+
+    real_fused = dk.fused_decode_attention
+
+    def run_decode(force_kernel):
+        if force_kernel:
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda n_q, *a, **kw: 1 <= n_q <= 8)
+            monkeypatch.setattr(
+                dk, "fused_decode_attention",
+                lambda *a, **kw: real_fused(*a, interpret=True),
+            )
+        else:
+            monkeypatch.setattr(dk, "decode_kernel_supported", lambda *a, **kw: False)
+        cache = model.init_cache(batch_size=2)
+        logits, cache = model.apply(params, x, 4, cache, pad_mask=pad, method=CausalSequenceModel.prefill)
+        outs = [np.asarray(logits)]
+        for t in range(2):
+            tok = jnp.full((2, 1), 7 + t, jnp.int32)
+            logits, cache = model.apply(params, tok, cache, method=CausalSequenceModel.decode_step)
+            outs.append(np.asarray(logits))
+        chunk = jnp.asarray([[3, 4, 5, 6], [9, 10, 11, 12]], jnp.int32)
+        logits, cache = model.apply(params, chunk, cache, method=CausalSequenceModel.decode_block)
+        outs.append(np.asarray(logits))
+        return outs
+
+    plain = run_decode(False)
+    fused = run_decode(True)
+    for p, f in zip(plain, fused):
+        np.testing.assert_allclose(f, p, atol=2e-5)
+
+
 def test_fused_decode_attention_auto_sharded_batch():
     """Mesh-aware dispatch: under a batch-sharded ambient mesh the kernel runs
     per-device inside shard_map (interpret mode on the 8-virtual-device CPU
