@@ -366,21 +366,32 @@ def run_optical_flow_epe(steps: int):
                                    max_shift=max_shift, max_rot_deg=max_rot)
     data.setup()
 
+    # Probe-scale trainability (diagnosed via a pixelwise-MLP control that DID
+    # learn this data, then bisected on the perceiver):
+    #   * encoder init_scale 0.25 — at the 0.02 default the 54->hidden content
+    #     projection lands ~1% of the feature variance next to the O(1) Fourier
+    #     position channels, starving every input-dependent path of gradient;
+    #   * decoder rescale_factor 1.0 — the official head divides by 100
+    #     (huggingface flow-model convention), so from a 0.02-scale init the
+    #     kernel must grow ~100x before outputs reach target scale;
+    #   * cross_attention_residual=True + widening 4 — the official 41M config
+    #     runs residual-free (per-pixel evidence reaches the output only
+    #     through attention weights over latent values), a route that needs the
+    #     official scale to train; the residual (also a reference decoder
+    #     option) gives dense query features a direct path to the flow head.
+    # With all three, train MSE drops ~10x below the zero-flow floor within
+    # 300 steps; with any one missing it sits AT the floor for 600+ steps.
     enc = OpticalFlowEncoderConfig(
         image_shape=shape, num_patch_input_channels=27, num_patch_hidden_channels=32,
         num_frequency_bands=16, num_cross_attention_heads=1, num_self_attention_heads=4,
         num_self_attention_layers_per_block=4, num_self_attention_blocks=1,
+        init_scale=0.25,
     )
     dec = OpticalFlowDecoderConfig(
         image_shape=shape, num_cross_attention_qk_channels=64,
         num_cross_attention_v_channels=64, num_cross_attention_heads=1,
-        # the official 41M config runs residual-free (values reach the output
-        # only FROM the latents, per-pixel evidence only through attention
-        # weights) — that information route needs the official scale to train.
-        # At probe scale the residual knob (also a reference decoder option)
-        # gives the dense per-pixel query features a direct path to the flow
-        # head, which is what makes the task learnable at ~200K params.
-        cross_attention_residual=True,
+        cross_attention_residual=True, cross_attention_widening_factor=4,
+        rescale_factor=1.0,
     )
     cfg = OpticalFlowConfig(encoder=enc, decoder=dec, num_latents=128, num_latent_channels=64)
     model = OpticalFlow(config=cfg, deterministic=False)
@@ -419,7 +430,9 @@ def run_optical_flow_epe(steps: int):
     def track_best(state, val):
         if float(val["loss"]) < best["loss"]:
             best["loss"] = float(val["loss"])
-            best["params"] = state.params
+            # COPY: the trainer's jitted step donates the state buffers, so a
+            # bare reference is dead (Array deleted) by the next train step
+            best["params"] = jax.tree.map(jnp.copy, state.params)
 
     history, n_params, state = _fit(
         model, eval_model, data, steps, lr=2e-3,
