@@ -1,0 +1,91 @@
+"""Direct-loop runner for the clm_markov_5m convergence artifact: same production components
+(create_sharded_train_state, make_causal_lm_train_step/eval_step, bf16 +
+dots-saveable remat + fused qkv on the data(2) x fsdp(4) mesh), same analytic
+floor and artifact format as scripts/convergence.py run_clm(production=True);
+the Trainer wrapper is bypassed because its donated-buffer step deadlocks
+XLA:CPU's 8-device rendezvous on this 1-core host (NOTES.md round-5 lesson) —
+the step program itself is identical."""
+import json, sys, time
+import jax, jax.numpy as jnp, numpy as np, optax
+jax.config.update("jax_platforms", "cpu")
+from perceiver_io_tpu.data.text.synthetic import SyntheticTextDataModule
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.training.trainer import TrainState, make_causal_lm_train_step, make_causal_lm_eval_step
+from perceiver_io_tpu.parallel.api import create_sharded_train_state
+from perceiver_io_tpu.parallel.mesh import make_mesh, batch_sharding
+
+steps = int(sys.argv[1]) if len(sys.argv) > 1 else 2600
+seq, batch, lr = 256, 8, 2e-3
+data = SyntheticTextDataModule(source="markov", seq_len=seq, batch_size=batch,
+                               n_train_tokens=steps * batch * (seq + 1),
+                               n_val_tokens=192 * seq, vocab_size=32)
+data.setup()
+cfg = CausalSequenceModelConfig(
+    vocab_size=data.effective_vocab_size, max_seq_len=seq, max_latents=seq // 2,
+    num_channels=256, num_heads=8, num_self_attention_layers=8,
+    cross_attention_dropout=0.0, activation_checkpointing=True,
+    remat_policy="dots_with_no_batch_dims_saveable", fused_qkv=True,
+)
+model = CausalSequenceModel(config=cfg, deterministic=False, dtype=jnp.bfloat16)
+eval_model = CausalSequenceModel(config=cfg, deterministic=True, dtype=jnp.bfloat16)
+mesh = make_mesh({"data": 2, "fsdp": 4})
+tx = optax.chain(optax.clip_by_global_norm(1.0),
+                 optax.adamw(optax.warmup_cosine_decay_schedule(0.0, lr, 150, steps)))
+rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)}
+x0 = jnp.zeros((2, seq), jnp.int32)
+with jax.sharding.set_mesh(mesh):
+    state, state_sh = create_sharded_train_state(
+        lambda: model.init(rngs, x0, prefix_len=seq // 2), tx, mesh)
+    n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(
+        jax.eval_shape(lambda: model.init(rngs, x0, prefix_len=seq // 2))))
+    print("params:", n_params, flush=True)
+    step_fn = jax.jit(make_causal_lm_train_step(model, tx, max_latents=seq // 2),
+                      in_shardings=(state_sh, batch_sharding(mesh)), out_shardings=(state_sh, None))
+    eval_fn = jax.jit(make_causal_lm_eval_step(eval_model, max_latents=seq // 2),
+                      in_shardings=(state_sh.params, batch_sharding(mesh)), out_shardings=None)
+
+    def run_eval(params):
+        tot, nb = 0.0, 0
+        for vb in data.val_dataloader():
+            vb = {k: jax.device_put(jnp.asarray(v), batch_sharding(mesh)) for k, v in vb.items()}
+            tot += float(eval_fn(params, vb)["loss"]); nb += 1
+        return tot / max(nb, 1)
+
+    history, best, i = [], float("inf"), 0
+    t0 = time.time()
+    train_iter = iter(data.train_dataloader())
+    while i < steps:
+        try:
+            bt = next(train_iter)
+        except StopIteration:
+            train_iter = iter(data.train_dataloader()); continue
+        bt = {k: jax.device_put(jnp.asarray(v), batch_sharding(mesh)) for k, v in bt.items()}
+        state, m = step_fn(state, bt)
+        i += 1
+        if i % 216 == 0 or i == steps:
+            tl = float(m["loss"]); vl = run_eval(state.params)
+            best = min(best, vl)
+            history.append({"step": i, "loss": round(tl, 5), "val_loss": round(vl, 5)})
+            print(json.dumps(history[-1]), f"({(time.time()-t0)/i:.2f}s/step)", flush=True)
+
+floor = float(data.entropy_floor)
+out = {
+    "task": "clm_markov_5m", "model_params": n_params,
+    "achieved_val_ce_nats": best, "history": history, "profile": "cpu",
+    "execution_path": {
+        "mesh": {"data": 2, "fsdp": 4}, "parallel_mode": "fsdp (ZeRO-3 param/moment sharding)",
+        "dtype": "bfloat16 compute, float32 params + softmax/LN stats",
+        "remat_policy": cfg.remat_policy, "fused_qkv": cfg.fused_qkv, "scanned_layers": True,
+        "runner": "direct step loop (scripts/convergence.py components; Trainer wrapper "
+                  "bypassed: its donated-buffer step deadlocks XLA:CPU 8-device rendezvous "
+                  "on this 1-core host — NOTES.md round-5)",
+    },
+    "target": {"metric": "val_loss", "value": floor, "tolerance_nats": 0.05,
+               "provenance": "analytic conditional entropy of the order-2 Markov corpus"},
+    "met": bool(best <= floor + 0.05),
+    "entropy_floor_nats": floor, "gap_nats": best - floor,
+}
+with open("convergence/clm_markov_5m.json", "w") as f:
+    json.dump(out, f, indent=1)
+print(json.dumps({k: v for k, v in out.items() if k != "history"}), flush=True)
