@@ -2,9 +2,12 @@
 (create_sharded_train_state, make_causal_lm_train_step/eval_step, bf16 +
 dots-saveable remat + fused qkv on the data(2) x fsdp(4) mesh), same analytic
 floor and artifact format as scripts/convergence.py run_clm(production=True);
-the Trainer wrapper is bypassed because its donated-buffer step deadlocks
-XLA:CPU's 8-device rendezvous on this 1-core host (NOTES.md round-5 lesson) —
-the step program itself is identical."""
+the Trainer wrapper is bypassed because the Trainer-wrapped run reproducibly
+deadlocked XLA:CPU's 8-device rendezvous at this model size on this 1-core
+host (3/3 attempts, always all-gather op_id=96; a controlled 12-step arm
+exonerated donate_argnums alone — the trigger is an unisolated thread-
+scheduling race in the wrapped path; NOTES.md round-5). The compiled step
+program itself is identical."""
 import json, sys, time
 import jax, jax.numpy as jnp, numpy as np, optax
 jax.config.update("jax_platforms", "cpu")
@@ -78,8 +81,9 @@ out = {
         "dtype": "bfloat16 compute, float32 params + softmax/LN stats",
         "remat_policy": cfg.remat_policy, "fused_qkv": cfg.fused_qkv, "scanned_layers": True,
         "runner": "direct step loop (scripts/convergence.py components; Trainer wrapper "
-                  "bypassed: its donated-buffer step deadlocks XLA:CPU 8-device rendezvous "
-                  "on this 1-core host — NOTES.md round-5)",
+                  "bypassed: the wrapped run reproducibly deadlocked XLA:CPU's 8-device "
+                  "rendezvous at this size — donation exonerated by a controlled arm; "
+                  "NOTES.md round-5)",
     },
     "target": {"metric": "val_loss", "value": floor, "tolerance_nats": 0.05,
                "provenance": "analytic conditional entropy of the order-2 Markov corpus"},
