@@ -188,6 +188,33 @@ def test_pipeline_fsdp_gradients_match(setup):
     )
 
 
+def test_stacked_param_specs_match_train_state_rule():
+    """The pipeline region's param view (stacked_param_specs) must agree with
+    infer_param_shardings' at-rest placement for every stacked leaf — the
+    'cannot drift' contract the pipe x fsdp design rests on (both share
+    _spec_for, but THIS pins the composed outputs)."""
+    from perceiver_io_tpu.parallel.sharding import infer_param_shardings, stacked_param_specs
+
+    mesh = make_mesh({"data": 2, "pipe": 2, "fsdp": 2}, devices=jax.devices()[:8])
+    stacked = {
+        "attention": {"qkv_proj": {"kernel": jnp.zeros((4, 32, 96))},
+                      "o_proj": {"kernel": jnp.zeros((4, 32, 32))}},
+        "mlp": {"dense_1": {"kernel": jnp.zeros((4, 32, 128)), "bias": jnp.zeros((4, 128))}},
+        "norm": {"scale": jnp.zeros((4, 32))},
+    }
+    region = stacked_param_specs(stacked, mesh, "pipe", min_fsdp_size=1)
+    at_rest = infer_param_shardings(
+        {"params": {"self_attention": {"layers": stacked}}}, mesh,
+        min_fsdp_size=1, pipeline_axis="pipe",
+    )["params"]["self_attention"]["layers"]
+    jax.tree_util.tree_map_with_path(
+        lambda path, r, a: (
+            np.testing.assert_equal(tuple(r), tuple(a.spec), err_msg=str(path))
+        ),
+        region, at_rest,
+    )
+
+
 def test_pipeline_rejects_tensor_mesh(setup):
     _, piped, params, x = setup
     mesh = make_mesh({"tensor": 2, "pipe": 4}, devices=jax.devices()[:8])
