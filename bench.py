@@ -581,7 +581,10 @@ def _driver_main() -> int:
                  f"{1 + len(_PROBE_BACKOFFS_S)} probes over ~{sum(_PROBE_BACKOFFS_S) // 60} min, "
                  "and no opportunistic records were captured by `bench.py --watch` this round.")
             _log("Diagnosis: the axon PJRT tunnel is down or wedged on this host — this is a platform "
-                 "failure, not a framework one. Re-run `python bench.py` when the tunnel recovers; "
+                 "failure, not a framework one. Round-long evidence of continuous probing is in "
+                 "bench_attempts.jsonl (every --watch attempt, timestamped); the tunnel-independent "
+                 "per-task FLOPs/bytes + implied-throughput record is BENCH_proxy.json "
+                 "(scripts/xla_cost_proxy.py). Re-run `python bench.py` when the tunnel recovers; "
                  "each task also runs standalone via `python bench.py --task "
                  "clm|clm_8k|optical_flow|decode`.")
             return 1
