@@ -333,6 +333,22 @@ def test_bootstrap_degenerate_ladder_returns_null_cis():
         assert "unidentifiable" in cis["note"]
 
 
+def test_supervise_kills_stalled_child_and_retries(tmp_path, monkeypatch, capfd):
+    """--supervise: a child that produces no output within the stall window is
+    killed and relaunched, 3 attempts then rc=1 — the mitigation for XLA:CPU's
+    probabilistic 8-device launch-time rendezvous wedge (NOTES.md round 5).
+    The 0.2s stall makes every (healthy) child look wedged: jax import alone
+    is silent for seconds, so the kill path is exercised deterministically."""
+    from perceiver_io_tpu.scripts import convergence
+
+    monkeypatch.setenv("PERCEIVER_IO_TPU_SUPERVISE_STALL_S", "0.2")
+    rc = convergence._supervise(["--task", "clm_markov", "--steps", "2", "--out", str(tmp_path)])
+    assert rc == 1
+    out = capfd.readouterr().out
+    assert out.count("killing wedged attempt") == 3
+    assert "3 attempts all wedged" in out
+
+
 def test_refit_reports_identification(tmp_path):
     """refit() on synthetic two-run CSVs: records law_free + CIs and counts
     interior points only where ranges genuinely overlap."""
