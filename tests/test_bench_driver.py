@@ -217,6 +217,47 @@ def test_driver_prefers_live_but_falls_back_per_task(bench, stub_script, monkeyp
     assert headline["tasks"]["bad_flow"]["value"] == 7.0  # fold-in filled the failure
 
 
+def test_run_extras_one_shot_semantics(bench, tmp_path, monkeypatch):
+    """_run_extras: an existing artifact short-circuits; a failing extra is
+    attempted once (settled=True — one shot per watcher run, no infinite
+    retry); a lock-blocked extra reports settled=False so the watch loop
+    retries next cycle instead of exiting."""
+    import fcntl
+
+    art = tmp_path / "EXTRA.json"
+    ok_script = tmp_path / "extra_ok.py"
+    ok_script.write_text(f"open({str(art)!r}, 'w').write('{{}}')\n")
+    bad_script = tmp_path / "extra_bad.py"
+    bad_script.write_text("import sys; sys.exit('extra exploded')\n")
+
+    # 1. success: artifact written, settled, extra_ok logged
+    monkeypatch.setattr(bench, "_EXTRA_TASKS", (("e1", [str(ok_script)], str(art), 30),))
+    assert bench._run_extras() is True
+    assert art.exists()
+    events = [json.loads(l)["event"] for l in open(bench._ATTEMPTS_PATH)]
+    assert events[-1] == "extra_ok"
+
+    # 2. artifact present: nothing runs (no new attempt logged)
+    assert bench._run_extras() is True
+    assert [json.loads(l)["event"] for l in open(bench._ATTEMPTS_PATH)] == events
+
+    # 3. failure: attempted once, still settled (no retry loop), extra_failed
+    art2 = tmp_path / "EXTRA2.json"
+    monkeypatch.setattr(bench, "_EXTRA_TASKS", (("e2", [str(bad_script)], str(art2), 30),))
+    assert bench._run_extras() is True
+    assert not art2.exists()
+    last = json.loads(open(bench._ATTEMPTS_PATH).readlines()[-1])
+    assert last["event"] == "extra_failed" and "exploded" in last["note"]
+
+    # 4. peer holds the bench lock: skipped, NOT settled -> caller retries
+    with open(bench._LOCK_PATH, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        assert bench._run_extras() is False
+        fcntl.flock(f, fcntl.LOCK_UN)
+    last = json.loads(open(bench._ATTEMPTS_PATH).readlines()[-1])
+    assert last["event"] == "extra_skipped_peer_running"
+
+
 def test_stale_round_partial_is_ignored(bench, monkeypatch, capfd):
     """Records captured in round N must not fold into round N+1's artifact:
     a partial file stamped with an older round reads as empty."""
