@@ -57,5 +57,6 @@ from perceiver_io_tpu.ops.position import (
     positions,
 )
 from perceiver_io_tpu.pipelines import OpticalFlowPipeline, SymbolicAudioPipeline, TextGenerationPipeline
+from perceiver_io_tpu.serving import EngineMetrics, ServedRequest, ServingEngine, SlotScheduler
 
 __version__ = "0.1.0"

@@ -62,3 +62,57 @@ def sample_token(rng: jax.Array, logits: jax.Array, do_sample: bool) -> jax.Arra
     if do_sample:
         return jax.random.categorical(rng, logits, axis=-1)
     return logits.argmax(axis=-1)
+
+
+# ---------------------------------------------------------------- batched (serving)
+# Per-ROW processors for the continuous-batching engine (serving/engine.py):
+# every parameter is a traced (B,) array, so one compiled decode step serves
+# any mix of per-request sampling configs with no recompilation. Disabled
+# rows are bitwise-identical to their input (x / 1.0 is exact under IEEE-754;
+# masked variants are gated behind a row-wise where), which is what makes
+# greedy engine decode token-identical to ``generate()``.
+
+
+def process_logits_batched(
+    logits: jax.Array, temperature: jax.Array, top_k: jax.Array, top_p: jax.Array
+) -> jax.Array:
+    """Vectorized temperature/top-k/top-p over (B, V) logits with per-row
+    traced parameters: ``temperature`` (B,) > 0 (1.0 = neutral), ``top_k``
+    (B,) int (<= 0 = disabled), ``top_p`` (B,) float (>= 1.0 = disabled).
+    The two vocab sorts are behind a ``lax.cond``: an all-greedy batch (the
+    common serving case) skips them at runtime inside the one program."""
+    logits = logits / temperature[:, None]
+
+    def _filter(lg):
+        # top-k with a traced k: threshold = k-th largest via descending sort
+        v = lg.shape[-1]
+        sorted_desc = jnp.sort(lg, axis=-1)[..., ::-1]
+        k_idx = jnp.clip(top_k, 1, v)[:, None] - 1
+        kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)
+        k_filtered = jnp.where(lg < kth, -jnp.inf, lg)
+        lg = jnp.where((top_k > 0)[:, None], k_filtered, lg)
+
+        # top-p on the (possibly k-filtered) logits, same construction as apply_top_p
+        sorted_desc = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p[:, None]
+        pth = jnp.take_along_axis(sorted_desc, keep_sorted.sum(-1, keepdims=True) - 1, axis=-1)
+        p_filtered = jnp.where(lg < pth, -jnp.inf, lg)
+        return jnp.where((top_p < 1.0)[:, None], p_filtered, lg)
+
+    any_filter = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
+    return jax.lax.cond(any_filter, _filter, lambda lg: lg, logits)
+
+
+def sample_token_batched(rngs: jax.Array, logits: jax.Array, do_sample: jax.Array) -> jax.Array:
+    """Per-row sampling: ``rngs`` (B, 2) one PRNG key per row, ``do_sample``
+    (B,) bool selecting categorical vs argmax per row. The categorical draw
+    is behind a ``lax.cond`` so all-greedy batches pay only the argmax."""
+    greedy = logits.argmax(axis=-1)
+
+    def _draw(g):
+        sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(rngs, logits)
+        return jnp.where(do_sample, sampled, g)
+
+    return jax.lax.cond(jnp.any(do_sample), _draw, lambda g: g, greedy)

@@ -82,6 +82,20 @@ class PerceiverARCache(flax.struct.PyTreeNode):
             sa=self.sa.replace(length=jnp.maximum(self.sa.length - k, 0)),
         )
 
+    def write_slot(self, slot: jax.Array, src: "PerceiverARCache") -> "PerceiverARCache":
+        """Install a single request's cache (batch size 1) into batch row
+        ``slot`` of this batched cache — the admission primitive of the
+        serving engine (serving/engine.py). Cache LENGTHS are shared scalars
+        across the batch and are kept from ``self``: the caller must have
+        filled ``src`` to the same lengths (the engine prefills every request
+        to the full window, so both sides always sit at capacity)."""
+        return PerceiverARCache(
+            ca=self.ca.write_batch_row(slot, src.ca, batch_axis=0),
+            sa=self.sa.write_batch_row(slot, src.sa, batch_axis=1),
+            pad_slots=jax.lax.dynamic_update_slice_in_dim(self.pad_slots, src.pad_slots, slot, axis=0),
+            shift=jax.lax.dynamic_update_slice_in_dim(self.shift, src.shift, slot, axis=0),
+        )
+
 
 def _make_ar_cache(
     batch_size: int, max_seq_len: int, max_latents: int, num_layers: int, num_channels: int, dtype=jnp.float32

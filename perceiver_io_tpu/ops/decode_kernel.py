@@ -194,13 +194,14 @@ def fused_decode_attention_auto(
     if plan is None or not plan[0]:
         return fused_decode_attention(q, k_cache, v_cache, rope_k, q_pos, pad_slots, interpret=interpret)
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from perceiver_io_tpu.parallel.ring_attention import _shard_map
 
     b = q.shape[0]
     baxes = plan[0]
     q_pos_b = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
-    fn = shard_map(
+    fn = _shard_map(
         lambda q, k, v, a, pos, pad: fused_decode_attention(q, k, v, a, pos, pad, interpret=interpret),
         in_specs=(
             P(baxes, None, None, None),
@@ -211,7 +212,7 @@ def fused_decode_attention_auto(
             P(baxes, None),
         ),
         out_specs=P(baxes, None, None, None),
-        check_vma=False,
+        mesh=None,
     )
     return fn(q, k_cache, v_cache, rope_k, q_pos_b, pad_slots)
 

@@ -52,6 +52,8 @@ def _mesh_plan():
 
     if jax.device_count() == 1:
         return ((), None, 1, 1)
+    import perceiver_io_tpu.parallel.mesh  # noqa: F401  (installs jax<0.5 get_abstract_mesh alias)
+
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return None

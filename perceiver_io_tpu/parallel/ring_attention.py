@@ -77,6 +77,16 @@ def _shard_map(fn, in_specs, out_specs, mesh):
         from jax.experimental.shard_map import shard_map
 
         kwargs["check_rep"] = False
+        if "mesh" not in kwargs:
+            # the legacy API cannot infer the ambient mesh from context the
+            # way jax.shard_map does; resolve it here (compat-shimmed on
+            # 0.4.x to the `with mesh:` resource env — parallel/mesh.py,
+            # whose import installs the alias)
+            import perceiver_io_tpu.parallel.mesh  # noqa: F401
+
+            ambient = jax.sharding.get_abstract_mesh()
+            if ambient is not None:
+                kwargs["mesh"] = ambient
     return shard_map(fn, in_specs=in_specs, out_specs=out_specs, **kwargs)
 
 

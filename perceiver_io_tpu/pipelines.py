@@ -33,28 +33,116 @@ from perceiver_io_tpu.generation.generate import GenerationConfig, generate
 
 @dataclass
 class TextGenerationPipeline:
-    """Prompt text -> generated text for CausalSequenceModel-family models."""
+    """Prompt text -> generated text for CausalSequenceModel-family models.
+
+    Single prompts (or ``use_engine=False``) run the one-shot ``generate()``
+    path. Multi-prompt batches route through the continuous-batching
+    ``ServingEngine`` (serving/engine.py) when ``num_latents`` is not
+    explicitly passed (any explicit value, including 1, pins the direct
+    path) and the generation config is servable (no beams/contrastive/
+    chunked speculation): requests with
+    different prompt lengths decode in one compiled step, EOS'd prompts free
+    their slot early, and repeated calls reuse the engine's compiled
+    programs regardless of batch composition. The engine's canonical form
+    pads every prompt to the full model window with ``num_latents =
+    max_latents`` (the window policy then evolves identically for every
+    request), so engine-path output corresponds to ``generate()`` on that
+    canonical padding rather than on the batch-max padding of the direct
+    path.
+    """
 
     model: object
     params: object
     tokenizer: Union[str, object] = "bytes"
+    engine_slots: Optional[int] = None  # None: one slot per prompt (capped at 8)
     # prompts are always LEFT-padded: the reference enforces left padding for
     # causal LMs (text/clm/lightning.py:45-48) and the decode slice relies on it
 
     def __post_init__(self):
         self._tokenizer = get_tokenizer(self.tokenizer) if isinstance(self.tokenizer, str) else self.tokenizer
+        # ONE engine for the pipeline's lifetime, sized at first use: its
+        # compiled programs and slot-pool cache are shared by every later
+        # batch regardless of composition (batches larger than the pool just
+        # queue — the scheduler multiplexes slots).
+        self._engine_inst = None
+
+    def _engine(self, first_batch: int):
+        from perceiver_io_tpu.serving import ServingEngine
+
+        if self._engine_inst is None:
+            num_slots = self.engine_slots or min(max(first_batch, 2), 8)
+            self._engine_inst = ServingEngine(self.model, self.params, num_slots=num_slots)
+        return self._engine_inst
+
+    def _generate_via_engine(self, seqs, config: "GenerationConfig", rng) -> List[List[int]]:
+        import dataclasses
+
+        # the engine left-pads its canonical form with config.pad_token_id;
+        # keep that aligned with the tokenizer's pad id (the direct path's
+        # padding) or pad-position embeddings would differ between the paths
+        if config.pad_token_id != self._tokenizer.pad_token_id:
+            config = dataclasses.replace(config, pad_token_id=self._tokenizer.pad_token_id)
+        engine = self._engine(len(seqs))
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        handles = [
+            engine.submit(s, config=config, rng=jax.random.fold_in(rng, i))
+            for i, s in enumerate(seqs)
+        ]
+        engine.run_until_drained()
+        return [h.output_ids for h in handles]
 
     def __call__(
         self,
         prompts: Union[str, Sequence[str]],
-        num_latents: int = 1,
+        num_latents: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        use_engine: Optional[bool] = None,
         **generation_kwargs,
     ) -> Union[str, List[str]]:
         single = isinstance(prompts, str)
         texts = [prompts] if single else list(prompts)
         tok = self._tokenizer
         seqs = [tok.encode(t) for t in texts]
+
+        config = generation_kwargs.pop("config", None)
+        if config is None:
+            config = GenerationConfig(**generation_kwargs)
+        elif generation_kwargs:
+            raise ValueError("pass either config or keyword options, not both")
+        from perceiver_io_tpu.serving.engine import _engine_compatible
+
+        # the engine always decodes on its canonical form (num_latents =
+        # max_latents), so ANY explicit num_latents — including 1 — pins the
+        # generate() direct path; prompt lengths outside the engine's
+        # admissible range (empty, or longer than the window) are gated HERE
+        # so a mid-batch submit can never fail after earlier requests were
+        # already enqueued on the shared long-lived engine
+        engine_ok = (
+            len(seqs) > 1
+            and num_latents is None
+            and all(0 < len(s) <= self.model.max_seq_len for s in seqs)
+            and _engine_compatible(config) is None
+        )
+        if use_engine is None:
+            use_engine = engine_ok
+        elif use_engine and not engine_ok:
+            reason = _engine_compatible(config) or (
+                "an explicit num_latents pins generate() (the engine decodes with max_latents)"
+                if num_latents is not None
+                else f"empty prompt or prompt longer than the window ({self.model.max_seq_len})"
+                if not all(0 < len(s) <= self.model.max_seq_len for s in seqs)
+                else "single prompt"
+            )
+            raise ValueError(
+                "use_engine=True requires a batch of > 1 prompts, default "
+                f"num_latents, and an engine-servable config (reason: {reason})"
+            )
+        if use_engine:
+            outputs = self._generate_via_engine(seqs, config, rng)
+            decoded = [tok.decode([t for t in out if t != tok.pad_token_id]) for out in outputs]
+            return [prompt + cont for prompt, cont in zip(texts, decoded)]
+
         n = max(len(s) for s in seqs)
         ids = np.full((len(seqs), n), tok.pad_token_id, np.int64)
         pad = np.ones((len(seqs), n), bool)
@@ -65,10 +153,10 @@ class TextGenerationPipeline:
             self.model,
             self.params,
             jnp.asarray(ids),
-            num_latents=num_latents,
+            num_latents=1 if num_latents is None else num_latents,
             pad_mask=jnp.asarray(pad),
             rng=rng,
-            **generation_kwargs,
+            config=config,
         )
         decoded = [tok.decode([t for t in row[n:].tolist() if t != tok.pad_token_id]) for row in np.asarray(out)]
         results = [prompt + cont for prompt, cont in zip(texts, decoded)]

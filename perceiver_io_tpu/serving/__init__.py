@@ -1,0 +1,25 @@
+"""Continuous-batching serving engine (see docs/serving.md).
+
+``ServingEngine`` multiplexes many heterogeneous generation requests over a
+fixed pool of decode slots inside ONE compiled decode step; ``SlotScheduler``
+owns admission/eviction policy and ``EngineMetrics`` the observability
+surface. ``scripts/serve_bench.py`` drives a synthetic workload through it.
+"""
+
+from perceiver_io_tpu.serving.engine import (
+    RequestStatus,
+    ServedRequest,
+    ServingEngine,
+    SlotState,
+)
+from perceiver_io_tpu.serving.metrics import EngineMetrics
+from perceiver_io_tpu.serving.scheduler import SlotScheduler
+
+__all__ = [
+    "EngineMetrics",
+    "RequestStatus",
+    "ServedRequest",
+    "ServingEngine",
+    "SlotScheduler",
+    "SlotState",
+]

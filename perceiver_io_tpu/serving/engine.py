@@ -1,0 +1,376 @@
+"""Continuous-batching inference engine over slot-based ring KV caches.
+
+The single-request decode stack (generation/generate.py) compiles one program
+per prompt shape and serves one request per scan. Serving heavy traffic needs
+the opposite: MANY heterogeneous requests advancing inside ONE compiled step
+whose shapes never change as requests come and go — the "Ragged Paged
+Attention" recipe (PAPERS.md) mapped onto this repo's fixed-capacity
+``PerceiverARCache`` ring buffers.
+
+Design (see docs/serving.md for the full writeup):
+
+  * The engine owns ``num_slots`` decode slots stacked into one batched
+    ``PerceiverARCache`` (batch axis = slot index). Cache lengths are shared
+    scalars, so every slot must sit at the SAME fill level at all times: the
+    engine pins the whole pool at full capacity by prefilling every request
+    left-padded to the full window (``max_seq_len`` tokens, ``max_latents``
+    latents — the canonical form; per-request left-pad counts live in the
+    cache's ``shift``/``pad_slots`` fields exactly as for padded batches).
+  * Admission = one batch-1 prefill (ONE static shape, compiled once) + a
+    row scatter into the pool (``PerceiverARCache.write_slot``).
+  * One jitted decode step advances ALL slots one token: per-slot sampling
+    parameters are traced (B,) arrays (``process_logits_batched``), so any
+    mix of greedy/temperature/top-k/top-p requests shares the one program.
+    Free slots decode pad tokens whose outputs are discarded — compute is
+    wasted, recompilation never happens.
+  * EOS/length bookkeeping is host-side: the scheduler evicts finished
+    requests and admits queued ones between steps. ``max_new_tokens`` is a
+    host counter, not a compiled loop bound, so mixed lengths are free.
+
+Greedy engine output is token-identical to ``generate()`` on the same
+canonical form (tests/test_serving.py pins this in float64); sampled output
+is reproducible per request seed but follows the engine's own key chain.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.generation.generate import GenerationConfig, _cache_dtype
+from perceiver_io_tpu.generation.sampling import process_logits_batched, sample_token_batched
+from perceiver_io_tpu.serving.metrics import EngineMetrics
+from perceiver_io_tpu.serving.scheduler import SlotScheduler
+
+
+class SlotState(flax.struct.PyTreeNode):
+    """Per-slot device state advanced by the compiled decode step.
+
+    ``next_logits``: (B, V) last-position logits (sampling input of the next
+        step — written by prefill at admission, by decode afterwards).
+    ``rng``: (B, 2) per-slot PRNG keys, split once per step.
+    ``active``: (B,) bool; inactive rows decode their pad token.
+    ``temperature``/``top_k``/``top_p``/``do_sample``: per-slot sampling
+        parameters in the traced encodings of ``process_logits_batched``.
+    ``pad_id``: (B,) token fed through inactive rows.
+    """
+
+    next_logits: jax.Array
+    rng: jax.Array
+    active: jax.Array
+    temperature: jax.Array
+    top_k: jax.Array
+    top_p: jax.Array
+    do_sample: jax.Array
+    pad_id: jax.Array
+
+    @staticmethod
+    def create(num_slots: int, vocab_size: int, logits_dtype=jnp.float32) -> "SlotState":
+        return SlotState(
+            next_logits=jnp.zeros((num_slots, vocab_size), logits_dtype),
+            rng=jnp.zeros((num_slots, 2), jnp.uint32),
+            active=jnp.zeros((num_slots,), bool),
+            temperature=jnp.ones((num_slots,), jnp.float32),
+            top_k=jnp.zeros((num_slots,), jnp.int32),
+            top_p=jnp.ones((num_slots,), jnp.float32),
+            do_sample=jnp.zeros((num_slots,), bool),
+            pad_id=jnp.zeros((num_slots,), jnp.int32),
+        )
+
+
+class RequestStatus(str, Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class ServedRequest:
+    """Handle returned by ``ServingEngine.submit``; mutated by the engine."""
+
+    request_id: int
+    prompt_ids: np.ndarray
+    config: GenerationConfig
+    rng: jax.Array
+    status: RequestStatus = RequestStatus.QUEUED
+    slot: Optional[int] = None
+    output_ids: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None  # "eos" | "length"
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status is RequestStatus.FINISHED
+
+    def result(self) -> np.ndarray:
+        """Generated tokens (prompt excluded), truncated at EOS inclusive."""
+        return np.asarray(self.output_ids, np.int32)
+
+
+def _engine_compatible(config: GenerationConfig) -> Optional[str]:
+    """None if the config runs on the engine, else the reason it cannot."""
+    if config.num_beams > 1:
+        return "beam search decodes k dependent continuations per request"
+    if config.penalty_alpha is not None and config.penalty_alpha > 0:
+        return "contrastive search re-scores k candidates per step"
+    if config.decode_chunk > 1:
+        return "chunked speculation shares one scalar commit length per batch"
+    if config.max_new_tokens < 1:
+        return "max_new_tokens must be >= 1"
+    if config.temperature <= 0.0:
+        return f"temperature must be > 0, got {config.temperature}"
+    return None
+
+
+class ServingEngine:
+    """In-process continuous-batching engine over a fixed slot pool.
+
+    ``submit()`` returns a handle immediately; ``step()`` runs one scheduler
+    tick (admit -> one batched decode token -> harvest/evict);
+    ``run_until_drained()`` loops until queue and slots are empty.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        num_slots: int = 4,
+        cache_dtype=None,
+        metrics_jsonl: Optional[str] = None,
+    ):
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.cache_dtype = cache_dtype if cache_dtype is not None else _cache_dtype(model)
+        self.scheduler: SlotScheduler[ServedRequest] = SlotScheduler(num_slots)
+        self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
+        self.finished: List[ServedRequest] = []
+        self._ids = itertools.count()
+        self._requests: Dict[int, ServedRequest] = {}
+
+        cfg = model.config
+        self._vocab = cfg.vocab_size
+        self._window = model.max_seq_len
+        self._prefix_len = model.max_prefix_len
+
+        # Device pool: batched cache pinned at FULL capacity (free slots hold
+        # zeros — harmless; see module docstring) + per-slot state.
+        cache = model.init_cache(batch_size=num_slots, dtype=self.cache_dtype)
+        self._cache = cache.replace(
+            ca=cache.ca.replace(length=jnp.asarray(cache.ca.capacity, jnp.int32)),
+            sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
+        )
+        # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
+        # serving); storing them narrower would silently cast at install
+        self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
+        self._build_jits()
+
+    # ------------------------------------------------------------------- jits
+    def _build_jits(self):
+        """Per-engine jit wrappers so ``_cache_size()`` counts THIS engine's
+        compilations (the churn test asserts decode compiles exactly once)."""
+        model, dtype, prefix_len = self.model, self.cache_dtype, self._prefix_len
+
+        @jax.jit
+        def prefill_one(params, ids, pad_mask):
+            cache = model.init_cache(batch_size=1, dtype=dtype)
+            logits, cache = model.apply(
+                params, ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill
+            )
+            return logits[:, -1], cache
+
+        # cache/state buffers are donated everywhere the caller immediately
+        # rebinds them: without donation every decoded token would COPY the
+        # full slot-pool KV cache (num_slots x layers x window x channels)
+        # instead of updating it in place. (CPU jax warns donation is
+        # unsupported and falls back to copies — correct either way.)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def install(cache, state, slot, req_cache, req_logits, rng,
+                    temperature, top_k, top_p, do_sample, pad_id):
+            cache = cache.write_slot(slot, req_cache)
+            state = state.replace(
+                next_logits=state.next_logits.at[slot].set(req_logits[0]),
+                rng=state.rng.at[slot].set(rng),
+                active=state.active.at[slot].set(True),
+                temperature=state.temperature.at[slot].set(temperature),
+                top_k=state.top_k.at[slot].set(top_k),
+                top_p=state.top_p.at[slot].set(top_p),
+                do_sample=state.do_sample.at[slot].set(do_sample),
+                pad_id=state.pad_id.at[slot].set(pad_id),
+            )
+            return cache, state
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def release(state, slot):
+            # reset sampling fields to their neutral encodings: a stale
+            # do_sample/top_k/top_p on a freed row would keep the decode
+            # step's any-row lax.cond branches (sampling.py) live and make
+            # all-greedy batches pay the vocab sorts forever
+            return state.replace(
+                active=state.active.at[slot].set(False),
+                do_sample=state.do_sample.at[slot].set(False),
+                temperature=state.temperature.at[slot].set(1.0),
+                top_k=state.top_k.at[slot].set(0),
+                top_p=state.top_p.at[slot].set(1.0),
+            )
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_step(params, cache, state):
+            # Mirrors _generate_single's loop body per row: process logits ->
+            # sample -> one cached model step. Inactive rows decode their pad
+            # token; their outputs are never harvested.
+            processed = process_logits_batched(
+                state.next_logits, state.temperature, state.top_k, state.top_p
+            )
+            keys = jax.vmap(jax.random.split)(state.rng)  # (B, 2, 2)
+            tok = sample_token_batched(keys[:, 1], processed, state.do_sample)
+            tok = jnp.where(state.active, tok, state.pad_id).astype(jnp.int32)
+            logits_t, cache = model.apply(
+                params, tok[:, None], cache, method=type(model).decode_step
+            )
+            state = state.replace(next_logits=logits_t[:, -1], rng=keys[:, 0])
+            return tok, cache, state
+
+        self._jit_prefill = prefill_one
+        self._jit_install = install
+        self._jit_release = release
+        self._jit_decode = decode_step
+
+    @property
+    def decode_compilations(self) -> int:
+        """Number of programs compiled for the decode step (target: 1)."""
+        return self._jit_decode._cache_size()
+
+    # ------------------------------------------------------------------ submit
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        config: Optional[GenerationConfig] = None,
+        rng: Optional[jax.Array] = None,
+        **kwargs,
+    ) -> ServedRequest:
+        """Queue one request; returns its handle. ``config``/kwargs follow
+        ``generate()``'s convention (pass one or the other)."""
+        if config is None:
+            config = GenerationConfig(**kwargs)
+        elif kwargs:
+            raise ValueError("pass either config or keyword options, not both")
+        reason = _engine_compatible(config)
+        if reason is not None:
+            raise ValueError(f"GenerationConfig not servable by the engine: {reason}")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if not 0 < prompt.size <= self._window:
+            raise ValueError(f"Input sequence length out of valid range [1..{self._window}]")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        elif jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            # SlotState.rng is a raw (B, 2) uint32 buffer (rows of one batched
+            # array cannot hold typed key objects); accept both key flavors
+            rng = jax.random.key_data(rng)
+        request = ServedRequest(
+            request_id=next(self._ids),
+            prompt_ids=prompt,
+            config=config,
+            rng=rng,
+            submitted_at=time.perf_counter(),
+        )
+        self._requests[request.request_id] = request
+        self.scheduler.enqueue(request)
+        self.metrics.record_submit(request.request_id, int(prompt.size))
+        return request
+
+    # ------------------------------------------------------------------- admit
+    def _canonical_prompt(self, request: ServedRequest):
+        """Left-pad the prompt to the full window (the engine's one prefill
+        shape); pad positions are masked and position-shifted exactly as in
+        the padded-batch pipeline path."""
+        n = request.prompt_ids.size
+        ids = np.full((1, self._window), request.config.pad_token_id, np.int32)
+        pad = np.ones((1, self._window), bool)
+        ids[0, self._window - n:] = request.prompt_ids
+        pad[0, self._window - n:] = False
+        return jnp.asarray(ids), jnp.asarray(pad)
+
+    def _admit(self, slot: int, request: ServedRequest) -> None:
+        cfg = request.config
+        t0 = time.perf_counter()
+        ids, pad_mask = self._canonical_prompt(request)
+        req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask)
+        self._cache, self._state = self._jit_install(
+            self._cache, self._state, slot, req_cache, req_logits, request.rng,
+            float(cfg.temperature),
+            int(cfg.top_k) if cfg.top_k else 0,
+            float(cfg.top_p) if cfg.top_p is not None else 1.0,
+            bool(cfg.do_sample),
+            int(cfg.pad_token_id),
+        )
+        jax.block_until_ready(self._state.next_logits)
+        now = time.perf_counter()
+        request.status = RequestStatus.RUNNING
+        request.slot = slot
+        request.admitted_at = now
+        self.metrics.record_admit(
+            request.request_id, slot, wait_s=now - request.submitted_at, prefill_s=now - t0
+        )
+
+    def _evict(self, slot: int, request: ServedRequest, reason: str) -> None:
+        self.scheduler.release(slot)
+        self._state = self._jit_release(self._state, slot)
+        request.status = RequestStatus.FINISHED
+        request.finish_reason = reason
+        request.finished_at = time.perf_counter()
+        request.slot = None
+        self._requests.pop(request.request_id, None)  # engines are long-lived: no per-request residue
+        self.finished.append(request)
+        self.metrics.record_finish(request.request_id, slot, len(request.output_ids), reason)
+
+    # -------------------------------------------------------------------- step
+    def step(self) -> bool:
+        """One scheduler tick: admit queued requests into free slots, advance
+        every occupied slot one token, harvest/evict finished requests.
+        Returns True while work remains (occupied slots or queued requests)."""
+        for slot, request in self.scheduler.pop_admissible():
+            self._admit(slot, request)
+        occupied = list(self.scheduler.occupied())
+        if not occupied:
+            return self.scheduler.has_work
+
+        t0 = time.perf_counter()
+        tok, self._cache, self._state = self._jit_decode(self.params, self._cache, self._state)
+        tok = np.asarray(tok)  # blocks: the step's device sync point
+        decode_s = time.perf_counter() - t0
+        self.metrics.record_decode_step(len(occupied), decode_s, tokens=len(occupied))
+
+        for slot, request in occupied:
+            token = int(tok[slot])
+            request.output_ids.append(token)
+            cfg = request.config
+            if cfg.eos_token_id is not None and token == cfg.eos_token_id:
+                self._evict(slot, request, "eos")
+            elif len(request.output_ids) >= cfg.max_new_tokens:
+                self._evict(slot, request, "length")
+        return self.scheduler.has_work
+
+    def run_until_drained(self, max_steps: Optional[int] = None) -> List[ServedRequest]:
+        """Step until every submitted request finished; returns (and drains)
+        the requests finished since the last drain, in completion order, so a
+        long-lived engine holds no per-request state between serving calls.
+        ``max_steps`` guards runaway loops in tests."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(f"engine not drained after {max_steps} steps")
+        drained, self.finished = self.finished, []
+        return drained

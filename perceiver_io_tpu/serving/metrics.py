@@ -1,0 +1,134 @@
+"""Engine observability: counters, timers, and a JSONL event log.
+
+The metrics layer is deliberately framework-free (a dict + an append-only
+JSONL file) so bench drivers can pin numbers without scraping stdout:
+``scripts/serve_bench.py`` embeds ``EngineMetrics.snapshot()`` verbatim in
+its artifact, and ``docs/serving.md`` documents the schema.
+
+Two throughput views are reported because they answer different questions:
+  * ``decode_tokens_per_s``  — useful tokens per second of *decode step* time
+    (the steady-state serving rate the batch geometry buys).
+  * ``wall_tokens_per_s``    — useful tokens per second of wall clock between
+    the first submit and the snapshot (what a client actually observes,
+    including prefill, scheduling, and host bookkeeping).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SCHEMA = "serving-metrics/v1"
+
+
+@dataclass
+class EngineMetrics:
+    """Mutable counters owned by one ``ServingEngine``; never touches jax."""
+
+    num_slots: int
+    jsonl_path: Optional[str] = None
+
+    requests_submitted: int = 0
+    requests_admitted: int = 0
+    requests_finished: int = 0
+    tokens_generated: int = 0  # useful tokens only (active slots)
+    decode_steps: int = 0
+    prefills: int = 0
+    decode_seconds: float = 0.0
+    prefill_seconds: float = 0.0
+    queue_depth: int = 0
+    _start_time: Optional[float] = None
+    _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
+    _queue_waits: List[float] = field(default_factory=list)
+    _jsonl_file: Optional[object] = field(default=None, repr=False)
+
+    # ------------------------------------------------------------------ events
+    def _emit(self, event: str, **fields) -> None:
+        if self.jsonl_path is None:
+            return
+        if self._jsonl_file is None:
+            # one line-buffered handle for the engine's lifetime: _emit runs
+            # once per decoded token, so per-event open/close syscalls would
+            # tax the hot decode loop; line buffering keeps readers current
+            self._jsonl_file = open(self.jsonl_path, "a", buffering=1)
+        record = {"event": event, "ts": round(time.time(), 6), **fields}
+        self._jsonl_file.write(json.dumps(record) + "\n")
+
+    def record_submit(self, request_id: int, prompt_len: int) -> None:
+        if self._start_time is None:
+            self._start_time = time.perf_counter()
+        self.requests_submitted += 1
+        self.queue_depth += 1
+        self._emit("submit", request_id=request_id, prompt_len=prompt_len)
+
+    def record_admit(self, request_id: int, slot: int, wait_s: float, prefill_s: float) -> None:
+        self.requests_admitted += 1
+        self.prefills += 1
+        self.prefill_seconds += prefill_s
+        self.queue_depth = max(self.queue_depth - 1, 0)
+        self._queue_waits.append(wait_s)
+        self._emit("admit", request_id=request_id, slot=slot,
+                   wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6))
+
+    def record_decode_step(self, active_slots: int, seconds: float, tokens: int) -> None:
+        self.decode_steps += 1
+        self.decode_seconds += seconds
+        self.tokens_generated += tokens
+        self._occupancy_sum += active_slots / max(self.num_slots, 1)
+        self._emit("decode_step", active_slots=active_slots,
+                   seconds=round(seconds, 6), tokens=tokens)
+
+    def record_finish(self, request_id: int, slot: int, new_tokens: int, reason: str) -> None:
+        self.requests_finished += 1
+        self._emit("finish", request_id=request_id, slot=slot,
+                   new_tokens=new_tokens, reason=reason)
+
+    # ---------------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict:
+        wall = (time.perf_counter() - self._start_time) if self._start_time else 0.0
+        waits = self._queue_waits
+        snap = {
+            "schema": SCHEMA,
+            "num_slots": self.num_slots,
+            "requests_submitted": self.requests_submitted,
+            "requests_admitted": self.requests_admitted,
+            "requests_finished": self.requests_finished,
+            "queue_depth": self.queue_depth,
+            "tokens_generated": self.tokens_generated,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "decode_seconds": round(self.decode_seconds, 6),
+            "prefill_seconds": round(self.prefill_seconds, 6),
+            "wall_seconds": round(wall, 6),
+            "decode_tokens_per_s": round(self.tokens_generated / self.decode_seconds, 3)
+            if self.decode_seconds > 0 else 0.0,
+            "wall_tokens_per_s": round(self.tokens_generated / wall, 3) if wall > 0 else 0.0,
+            "mean_slot_occupancy": round(self._occupancy_sum / self.decode_steps, 4)
+            if self.decode_steps > 0 else 0.0,
+            "queue_wait_s": {
+                "mean": round(sum(waits) / len(waits), 6) if waits else 0.0,
+                "max": round(max(waits), 6) if waits else 0.0,
+            },
+        }
+        return snap
+
+    def write_snapshot(self) -> Dict:
+        """Append the snapshot as a terminal JSONL event and return it."""
+        snap = self.snapshot()
+        self._emit("snapshot", **snap)
+        return snap
+
+    def close(self) -> None:
+        """Release the JSONL handle (call before replacing or discarding a
+        metrics object mid-process; safe to call repeatedly)."""
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+            self._jsonl_file = None
+
+    def __del__(self):  # best-effort backstop; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,86 @@
+"""Slot scheduler: FIFO admission of queued requests into free decode slots.
+
+The scheduler is pure host-side bookkeeping — it never touches jax. The
+engine owns the device state (batched cache + slot state pytree); the
+scheduler decides WHICH request occupies WHICH batch row and when. Keeping
+the policy isolated here means alternative policies (priority classes,
+shortest-prompt-first, deadline-aware eviction) can be dropped in without
+touching the compiled decode path.
+
+Design constraints inherited from the device side (docs/serving.md):
+  * the slot count is static — it is the batch dimension of the compiled
+    decode step, so the scheduler can never grow it, only multiplex over it;
+  * admission is one request at a time (each admission is one prefill call),
+    so ``pop_admissible`` yields (slot, request) pairs for the engine to
+    install sequentially;
+  * eviction frees the slot immediately — the engine's decode step feeds pad
+    tokens through inactive rows, so a freed slot costs compute but never
+    correctness.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Generic, Iterator, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class SlotScheduler(Generic[T]):
+    """FIFO queue + free-list over a fixed pool of ``num_slots`` decode slots."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._queue: Deque[T] = deque()
+        self._slots: List[Optional[T]] = [None] * num_slots
+        self._free: Deque[int] = deque(range(num_slots))
+        self.total_admissions = 0
+
+    # ------------------------------------------------------------------- state
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.active_slots > 0
+
+    def occupant(self, slot: int) -> Optional[T]:
+        return self._slots[slot]
+
+    def occupied(self) -> Iterator[Tuple[int, T]]:
+        """(slot, request) pairs for every occupied slot, slot order."""
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                yield slot, req
+
+    # ------------------------------------------------------------------ policy
+    def enqueue(self, request: T) -> None:
+        self._queue.append(request)
+
+    def pop_admissible(self) -> Iterator[Tuple[int, T]]:
+        """Yield (slot, request) admissions until slots or queue run out.
+        The slot is claimed as soon as the pair is yielded, so the engine can
+        interleave prefill/install work with further admissions."""
+        while self._queue and self._free:
+            slot = self._free.popleft()
+            request = self._queue.popleft()
+            self._slots[slot] = request
+            self.total_admissions += 1
+            yield slot, request
+
+    def release(self, slot: int) -> T:
+        """Free a slot (request finished or cancelled); returns the occupant.
+        Freed slots recycle LIFO-last so reuse is observable in tests."""
+        request = self._slots[slot]
+        if request is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self._slots[slot] = None
+        self._free.append(slot)
+        return request

@@ -1,0 +1,205 @@
+"""Serving-engine benchmark: continuous batching vs single-request generate().
+
+Replays a synthetic mixed-length workload (random prompt lengths, a small set
+of max_new_tokens values, staggered arrivals) through ``ServingEngine`` and
+through the per-request ``generate()`` baseline, and emits one JSON artifact
+with the engine's metrics snapshot (docs/serving.md schema) plus the
+head-to-head throughput comparison.
+
+Runs anywhere: ``JAX_PLATFORMS=cpu python scripts/serve_bench.py --preset tiny``
+finishes in under a minute and is what tests/test_serving.py smoke-drives.
+The ``bench`` preset uses the shared 30M-class decode shape (bench.py's
+``decode_bench_config``) for on-chip numbers.
+
+Fairness notes baked into the harness:
+  * both sides are timed AFTER a warmup pass so compile time is excluded from
+    the throughput comparison (compile counts are reported separately);
+  * the baseline serves requests back-to-back on the engine's canonical
+    padded shape (one prefill compile, like the engine) — per-request scan
+    programs still recompile per distinct max_new_tokens, which is itself
+    part of the single-request story and is reported as
+    ``baseline_compile_shapes``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_model(preset: str):
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+    if preset == "tiny":
+        config = CausalSequenceModelConfig(
+            vocab_size=262, max_seq_len=64, max_latents=16, num_channels=32,
+            num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.0,
+        )
+        return CausalSequenceModel(config=config), config
+    if preset == "bench":
+        from bench import decode_bench_config
+
+        config = decode_bench_config()
+        return CausalSequenceModel(config=config, dtype=jnp.bfloat16), config
+    raise SystemExit(f"unknown preset {preset!r} (tiny | bench)")
+
+
+def synth_workload(config, num_requests: int, seed: int):
+    """Mixed-length synthetic requests: prompt lengths across [4, window/2],
+    max_new from a small fixed menu (so the baseline compiles O(3) scan
+    programs, not O(n)), arrival staggered one submit per decode step."""
+    rng = np.random.RandomState(seed)
+    menu = (8, 16, 24)
+    requests = []
+    for i in range(num_requests):
+        plen = int(rng.randint(4, max(config.max_seq_len // 2, 5)))
+        requests.append({
+            "prompt": rng.randint(1, config.vocab_size, size=plen).tolist(),
+            "max_new_tokens": int(menu[i % len(menu)]),
+        })
+    return requests
+
+
+def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool):
+    from perceiver_io_tpu.serving import ServingEngine
+
+    engine = ServingEngine(model, params, num_slots=num_slots, metrics_jsonl=jsonl_path)
+    if warmup:
+        # one admission + one decode step compiles all three programs
+        h = engine.submit(requests[0]["prompt"], max_new_tokens=1)
+        engine.run_until_drained()  # drains engine.finished for the timed window
+        assert h.done
+        # fresh metrics: the timed window must not include warmup events
+        from perceiver_io_tpu.serving import EngineMetrics
+
+        engine.metrics.close()
+        engine.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=jsonl_path)
+
+    t0 = time.perf_counter()
+    pending = list(requests)
+    step = 0
+    # staggered arrivals: one new request per tick until the backlog is in
+    for i, r in enumerate(pending):
+        engine.submit(r["prompt"], max_new_tokens=r["max_new_tokens"],
+                      rng=jax.random.PRNGKey(i))
+        engine.step()
+        step += 1
+    while engine.step():
+        step += 1
+    wall = time.perf_counter() - t0
+    snap = engine.metrics.write_snapshot()
+    new_tokens = sum(len(h.output_ids) for h in engine.finished)
+    return {
+        "wall_seconds": round(wall, 4),
+        "new_tokens": new_tokens,
+        "tokens_per_s": round(new_tokens / wall, 2) if wall > 0 else 0.0,
+        "decode_compilations": engine.decode_compilations,
+        "metrics": snap,
+    }
+
+
+def run_baseline(model, params, requests, warmup: bool):
+    """Single-request serving: generate() per request, back-to-back, on the
+    canonical padded shape (prompt left-padded to the full window)."""
+    from perceiver_io_tpu.generation.generate import GenerationConfig, generate
+
+    window = model.max_seq_len
+    num_latents = model.max_latents
+
+    def one(r, i):
+        n = len(r["prompt"])
+        ids = np.zeros((1, window), np.int32)
+        pad = np.ones((1, window), bool)
+        ids[0, window - n:] = r["prompt"]
+        pad[0, window - n:] = False
+        out = generate(model, params, jnp.asarray(ids), num_latents=num_latents,
+                       pad_mask=jnp.asarray(pad), rng=jax.random.PRNGKey(i),
+                       config=GenerationConfig(max_new_tokens=r["max_new_tokens"]))
+        return jax.block_until_ready(out)
+
+    shapes = sorted({r["max_new_tokens"] for r in requests})
+    if warmup:
+        for m in shapes:  # compile each distinct scan length once
+            one({"prompt": requests[0]["prompt"], "max_new_tokens": m}, 0)
+
+    t0 = time.perf_counter()
+    for i, r in enumerate(requests):
+        one(r, i)
+    wall = time.perf_counter() - t0
+    new_tokens = sum(r["max_new_tokens"] for r in requests)
+    return {
+        "wall_seconds": round(wall, 4),
+        "new_tokens": new_tokens,
+        "tokens_per_s": round(new_tokens / wall, 2) if wall > 0 else 0.0,
+        "baseline_compile_shapes": shapes,
+    }
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--preset", default="tiny", choices=("tiny", "bench"))
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(_REPO, "SERVE_BENCH.json"))
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="optional per-event engine log (docs/serving.md schema)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="include compile time in both timings (debug only)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the single-request generate() comparison")
+    args = ap.parse_args(argv)
+
+    model, config = build_model(args.preset)
+    rng = jax.random.PRNGKey(args.seed)
+    init_ids = jnp.zeros((1, config.max_seq_len), jnp.int32)
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        rng, init_ids, prefix_len=model.max_prefix_len
+    )
+    requests = synth_workload(config, args.requests, args.seed)
+
+    engine_res = run_engine(model, params, requests, args.slots,
+                            args.metrics_jsonl, warmup=not args.no_warmup)
+    result = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": jax.default_backend(),
+        "preset": args.preset,
+        "workload": {
+            "requests": len(requests),
+            "slots": args.slots,
+            "prompt_lens": [len(r["prompt"]) for r in requests],
+            "max_new_tokens": [r["max_new_tokens"] for r in requests],
+        },
+        "engine": engine_res,
+    }
+    if not args.no_baseline:
+        base_res = run_baseline(model, params, requests, warmup=not args.no_warmup)
+        result["baseline_single_request"] = base_res
+        if base_res["tokens_per_s"] > 0:
+            result["engine_vs_baseline"] = round(
+                engine_res["tokens_per_s"] / base_res["tokens_per_s"], 3
+            )
+
+    tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps(result))
+    print(f"wrote {args.out}", file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    main()
