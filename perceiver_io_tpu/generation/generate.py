@@ -19,6 +19,7 @@ and EOS bookkeeping run inside the scan.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -83,6 +84,7 @@ def reorder_cache(cache: PerceiverARCache, idx: jax.Array) -> PerceiverARCache:
         sa=KVCache(k=cache.sa.k[:, idx], v=cache.sa.v[:, idx], length=cache.sa.length),
         pad_slots=cache.pad_slots[idx],
         shift=cache.shift[idx],
+        live=cache.live[idx],
     )
 
 
@@ -363,6 +365,16 @@ def generate(
         config = GenerationConfig(**kwargs)
     elif kwargs:
         raise ValueError("pass either config or keyword options, not both")
+    if (
+        not config.do_sample and config.num_beams == 1 and config.temperature != 1.0
+        and (config.penalty_alpha is None or config.penalty_alpha <= 0)
+    ):
+        # temperature is irrelevant under single-path greedy decoding (argmax is
+        # invariant to positive scaling): neutralize it so any value — including
+        # <= 0 — decodes, matching the serving engine's admission rule. Beam
+        # search keeps its temperature (it scales scores that ACCUMULATE), and
+        # contrastive search keeps its explicit temperature-has-no-effect error.
+        config = dataclasses.replace(config, temperature=1.0)
     prefix_len = _validate(model, input_ids.shape[1], num_latents)
     if rng is None:
         rng = jax.random.PRNGKey(0)

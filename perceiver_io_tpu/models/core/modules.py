@@ -174,6 +174,7 @@ class CrossAttention(nn.Module):
         rope_q: Optional[jax.Array] = None,
         rope_k: Optional[jax.Array] = None,
         kv_cache: Optional[KVCache] = None,
+        kv_live: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         from perceiver_io_tpu.parallel.mesh import constrain_batch_sharded
 
@@ -186,7 +187,9 @@ class CrossAttention(nn.Module):
             x_kv = constrain_batch_sharded(jnp.concatenate([x_kv_prefix, x_q], axis=1))
         else:
             x_kv = self.kv_norm(x_kv)
-        return self.attention(x_q, x_kv, pad_mask=pad_mask, rope_q=rope_q, rope_k=rope_k, kv_cache=kv_cache)
+        return self.attention(
+            x_q, x_kv, pad_mask=pad_mask, rope_q=rope_q, rope_k=rope_k, kv_cache=kv_cache, kv_live=kv_live
+        )
 
 
 class SelfAttention(nn.Module):
@@ -304,9 +307,11 @@ class CrossAttentionLayer(nn.Module):
         rope_q: Optional[jax.Array] = None,
         rope_k: Optional[jax.Array] = None,
         kv_cache: Optional[KVCache] = None,
+        kv_live: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         att, kv_cache = self.cross_attn(
-            x_q, x_kv=x_kv, x_kv_prefix=x_kv_prefix, pad_mask=pad_mask, rope_q=rope_q, rope_k=rope_k, kv_cache=kv_cache
+            x_q, x_kv=x_kv, x_kv_prefix=x_kv_prefix, pad_mask=pad_mask, rope_q=rope_q, rope_k=rope_k,
+            kv_cache=kv_cache, kv_live=kv_live,
         )
         att = self.res_dropout(att, deterministic=self.deterministic)
         x = att + x_q if self.attention_residual else att

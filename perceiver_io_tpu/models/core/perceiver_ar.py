@@ -57,12 +57,20 @@ class PerceiverARCache(flax.struct.PyTreeNode):
         holds a padding token; rolled in lockstep with ``ca``.
     ``shift``: (B, 1) int32 left-pad count (constant per sequence), subtracted from
         positions before clamping at 0.
+    ``live``: (B,) int32 count of live (non-pad) entries per row. The live region
+        is always the TAIL ``[ca.length - live, ca.length)`` of the valid slots
+        (left-pads sit at the head and roll out first), so masking a key slot
+        ``j`` iff ``j < ca.length - live`` is exactly equivalent to the pad-slot
+        mask — a redundancy the ragged decode kernel exploits to SKIP whole KV
+        blocks below each row's live region (ops/decode_kernel.py) while the
+        masked-softmax fallback applies the same bound for bitwise parity.
     """
 
     ca: KVCache
     sa: KVCache
     pad_slots: jax.Array
     shift: jax.Array
+    live: jax.Array
 
     @property
     def seq_len(self) -> jax.Array:
@@ -80,6 +88,7 @@ class PerceiverARCache(flax.struct.PyTreeNode):
         return self.replace(
             ca=self.ca.replace(length=jnp.maximum(self.ca.length - k, 0)),
             sa=self.sa.replace(length=jnp.maximum(self.sa.length - k, 0)),
+            live=jnp.maximum(self.live - k, 0),
         )
 
     def write_slot(self, slot: jax.Array, src: "PerceiverARCache") -> "PerceiverARCache":
@@ -88,12 +97,32 @@ class PerceiverARCache(flax.struct.PyTreeNode):
         serving engine (serving/engine.py). Cache LENGTHS are shared scalars
         across the batch and are kept from ``self``: the caller must have
         filled ``src`` to the same lengths (the engine prefills every request
-        to the full window, so both sides always sit at capacity)."""
+        to the full window), OR prefilled ``src`` at a smaller cross-attention
+        capacity (a bucketed prefill): then the bucket rows scatter into the
+        slot's TAIL and the head becomes masked left-pad (zero keys,
+        ``pad_slots=True``, ``shift`` grown by the offset) — positionally
+        identical to the canonical full-window form because cache slot ``j``
+        encodes position ``j - shift`` and both keys and RoPE tables shift
+        together."""
+        off = self.ca.capacity - src.ca.capacity
+        if off:
+            b = src.pad_slots.shape[0]
+            zk = jnp.zeros((b, off, src.ca.k.shape[-1]), src.ca.k.dtype)
+            zv = jnp.zeros((b, off, src.ca.v.shape[-1]), src.ca.v.dtype)
+            src = src.replace(
+                ca=src.ca.replace(
+                    k=jnp.concatenate([zk, src.ca.k], axis=1),
+                    v=jnp.concatenate([zv, src.ca.v], axis=1),
+                ),
+                pad_slots=jnp.concatenate([jnp.ones((b, off), bool), src.pad_slots], axis=1),
+                shift=src.shift + off,
+            )
         return PerceiverARCache(
             ca=self.ca.write_batch_row(slot, src.ca, batch_axis=0),
             sa=self.sa.write_batch_row(slot, src.sa, batch_axis=1),
             pad_slots=jax.lax.dynamic_update_slice_in_dim(self.pad_slots, src.pad_slots, slot, axis=0),
             shift=jax.lax.dynamic_update_slice_in_dim(self.shift, src.shift, slot, axis=0),
+            live=jax.lax.dynamic_update_slice_in_dim(self.live, src.live, slot, axis=0),
         )
 
 
@@ -107,6 +136,7 @@ def _make_ar_cache(
         sa=KVCache.create_stacked(num_layers, batch_size, max_latents, num_channels, num_channels, dtype),
         pad_slots=jnp.zeros((batch_size, max_seq_len), dtype=bool),
         shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
+        live=jnp.zeros((batch_size,), dtype=jnp.int32),
     )
 
 
@@ -307,6 +337,7 @@ class PerceiverAR(nn.Module):
         pad_slots = jnp.zeros((b, ca_cap), dtype=bool)
         if pad_mask is not None:
             pad_slots = pad_slots.at[:, :n].set(pad_mask)
+        live = jnp.full((b,), n, jnp.int32) - shift[:, 0]
 
         x_latent, ca_cache = self.cross_attention(
             x_latent,
@@ -315,6 +346,7 @@ class PerceiverAR(nn.Module):
             rope_q=frq_latent,
             rope_k=rope_k_ca,
             kv_cache=cache.ca,
+            kv_live=live,
         )
         # Self-attention cache slot j will hold latent j, i.e. sequence position
         # prefix_len + j; the RoPE table must span the full cache capacity.
@@ -323,7 +355,7 @@ class PerceiverAR(nn.Module):
         x_latent, sa_cache = self.self_attention(
             x_latent, rope_q=frq_latent, rope_k=rope_k_sa, kv_cache=cache.sa
         )
-        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=shift)
+        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=shift, live=live)
         return x_latent, new_cache
 
     def decode_block(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
@@ -369,8 +401,14 @@ class PerceiverAR(nn.Module):
         slot_pos = jnp.maximum(jnp.arange(ca_cap)[None, :] - cache.shift, 0)
         rope_k_ca = frequency_position_encoding(slot_pos, rot)
 
+        # n real tokens join; while the buffer is full each append rolls a
+        # left-pad (or, once none remain, a live token) out of the head —
+        # either way the live count saturates at capacity (see PerceiverARCache)
+        live = jnp.minimum(cache.live + n, ca_cap)
+
         x_latent, ca_cache = self.cross_attention(
-            x_emb, x_kv_prefix=x_emb[:, :0], pad_mask=pad_slots, rope_q=frq_q, rope_k=rope_k_ca, kv_cache=cache.ca
+            x_emb, x_kv_prefix=x_emb[:, :0], pad_mask=pad_slots, rope_q=frq_q, rope_k=rope_k_ca,
+            kv_cache=cache.ca, kv_live=live,
         )
 
         # Self-attention cache slot j holds the (j+1)-th oldest latent; its sequence
@@ -383,7 +421,7 @@ class PerceiverAR(nn.Module):
         x_latent, sa_cache = self.self_attention(
             x_latent, rope_q=frq_q, rope_k=rope_k_sa, kv_cache=cache.sa
         )
-        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=cache.shift)
+        new_cache = PerceiverARCache(ca=ca_cache, sa=sa_cache, pad_slots=pad_slots, shift=cache.shift, live=live)
         return x_latent, new_cache
 
     def decode_step(self, x: jax.Array, cache: PerceiverARCache) -> Tuple[jax.Array, PerceiverARCache]:
@@ -473,11 +511,18 @@ class CausalSequenceModel(nn.Module):
         hidden = self.ar(x, prefix_len=prefix_len, pad_mask=pad_mask)
         return self._head(hidden)
 
-    def init_cache(self, batch_size: int, dtype=jnp.float32) -> PerceiverARCache:
+    def init_cache(
+        self, batch_size: int, dtype=jnp.float32, max_seq_len: Optional[int] = None
+    ) -> PerceiverARCache:
         # Built from config only, so it works on an unbound module.
+        # ``max_seq_len`` overrides the cross-attention capacity for BUCKETED
+        # prefill (serving/engine.py): a prompt prefilled at a smaller bucket
+        # window produces a cache whose rows scatter into the tail of a
+        # full-window pool row (PerceiverARCache.write_slot).
         cfg = self.config
         return _make_ar_cache(
-            batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers, cfg.num_channels, dtype
+            batch_size, max_seq_len or cfg.max_seq_len, cfg.max_latents,
+            cfg.num_self_attention_layers, cfg.num_channels, dtype,
         )
 
     def prefill_with_hidden(

@@ -88,8 +88,10 @@ class KVCache(flax.struct.PyTreeNode):
         batched cache. ``batch_axis`` is 0 for plain caches and 1 for stacked
         per-layer caches (axis 0 is the scanned layer there). The scalar
         ``length`` is deliberately NOT copied: batched rows share one length,
-        and the caller must guarantee ``src`` was filled to exactly that
-        length (the engine's full-window prefill contract)."""
+        and the caller must guarantee ``src``'s k/v buffers span this cache's
+        full capacity with content positioned consistently with the shared
+        length (``PerceiverARCache.write_slot`` widens bucket-prefilled rows
+        into the tail — masked zero left-pad at the head — before calling)."""
         return self.replace(
             k=jax.lax.dynamic_update_slice_in_dim(self.k, src.k.astype(self.k.dtype), idx, axis=batch_axis),
             v=jax.lax.dynamic_update_slice_in_dim(self.v, src.v.astype(self.v.dtype), idx, axis=batch_axis),
@@ -205,6 +207,7 @@ class MultiHeadAttention(nn.Module):
         rope_q: Optional[jax.Array] = None,
         rope_k: Optional[jax.Array] = None,
         kv_cache: Optional[KVCache] = None,
+        kv_live: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         """Attend ``x_q`` (B, N, D) to ``x_kv`` (B, L, C).
 
@@ -212,11 +215,21 @@ class MultiHeadAttention(nn.Module):
         dim must equal the cache capacity (a slot-mask maintained by the caller).
         ``rope_q`` / ``rope_k``: rotary phase angles, one row per query / key row
         ((B, N, r) / (B, n_k, r)); callers do any right-alignment slicing.
+        ``kv_live``: optional (B,) per-row live-entry count for cached mode; key
+        slots below ``length - kv_live`` (the left-pad head) are masked — a
+        bound redundant with ``pad_mask`` that lets the fused decode kernel
+        SKIP those KV blocks entirely (ragged length-aware decode).
         Returns (output (B, N, F), updated cache or None).
         """
         num_qk, num_v, _ = self._dims()
         num_qk_per_head = num_qk // self.num_heads
         scale = num_qk_per_head**-0.5
+
+        if kv_live is not None:
+            from perceiver_io_tpu.ops.decode_kernel import ragged_decode_enabled
+
+            if kv_cache is None or not ragged_decode_enabled():
+                kv_live = None  # kill-switch / uncached: fall back to full-length masking
 
         if self.fused_qkv and not self.is_initializing():
             q, k, v = self._fused_projections(x_q, x_kv, num_qk, num_v)
@@ -255,7 +268,9 @@ class MultiHeadAttention(nn.Module):
                 pad = pad_mask if pad_mask is not None else jnp.zeros((b, n_k), bool)
                 if pad.shape[0] != b:
                     pad = jnp.broadcast_to(pad, (b, n_k))
-                o = fused_decode_attention_auto(q, kv_cache.k, kv_cache.v, ang, kv_cache.length - 1, pad)
+                o = fused_decode_attention_auto(
+                    q, kv_cache.k, kv_cache.v, ang, kv_cache.length - 1, pad, live=kv_live
+                )
                 o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
                 return self.o_proj(o), kv_cache
 
@@ -325,10 +340,24 @@ class MultiHeadAttention(nn.Module):
                 # buffer); query row i has absolute position length - n_q + i.
                 q_pos = kv_cache.length - n_q + jnp.arange(n_q)
                 visible = jnp.arange(n_k)[None, :] <= q_pos[:, None]
-                attn = jnp.where(visible[None, None, :, :], attn, neg)
+                if kv_live is not None:
+                    # ragged lower bound: slots below each row's live tail are
+                    # dead left-pads — the same bound the fused kernel skips
+                    # whole KV blocks by, applied here for bitwise parity
+                    lo = (kv_cache.length - kv_live)[:, None, None]  # (B, 1, 1)
+                    visible = visible[None] & (jnp.arange(n_k)[None, None, :] >= lo)
+                    attn = jnp.where(visible[:, None, :, :], attn, neg)
+                else:
+                    attn = jnp.where(visible[None, None, :, :], attn, neg)
         elif kv_cache is not None:
             valid = jnp.arange(n_k) < kv_cache.length
-            attn = jnp.where(valid[None, None, None, :], attn, neg)
+            if kv_live is not None:
+                valid = valid[None, :] & (
+                    jnp.arange(n_k)[None, :] >= (kv_cache.length - kv_live)[:, None]
+                )
+                attn = jnp.where(valid[:, None, None, :], attn, neg)
+            else:
+                attn = jnp.where(valid[None, None, None, :], attn, neg)
 
         attn = jax.nn.softmax(attn, axis=-1)
         attn = self.attn_dropout(attn, deterministic=self.deterministic)

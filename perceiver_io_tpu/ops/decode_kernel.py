@@ -29,6 +29,14 @@ import jax.numpy as jnp
 _BLOCK = 512
 
 
+def ragged_decode_enabled() -> bool:
+    """Kill-switch for ragged (live-length-aware) decode masking/skipping:
+    PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1 makes per-row live lengths fall
+    back to the full valid length (pad masking alone — the pre-ragged
+    behavior). Checked at trace time, like the kernel kill-switch."""
+    return os.environ.get("PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE", "0").lower() in ("0", "false", "")
+
+
 def decode_kernel_supported(
     n_q: int, capacity: int, num_qk: int, num_v: int, num_heads: int = 1,
     batch_size: Optional[int] = None,
@@ -89,10 +97,15 @@ def _head_expander(h: int, d: int):
     return np.kron(np.eye(h, dtype=np.float32), np.ones((1, d), np.float32))
 
 
-def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref):
+def _kernel(qpos_ref, live_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref):
     """Grid (B, num_blocks); block i covers cache slots [i*blk, (i+1)*blk).
 
     qpos_ref (B,)            absolute position of the LAST query (scalar-prefetch, SMEM)
+    live_ref (B,)            live (non-pad) entries per row; the live region is the
+                             TAIL [qpos+1-live, qpos+1) of the valid slots. Blocks
+                             entirely below it are dead: their grid steps alias the
+                             first live block in the index maps (no new DMA) and
+                             skip all compute — the ragged length-aware early exit.
     qbd_ref  (h*d, n_q*h)    block-diagonal scaled+rotated queries (col qi*h+head
                              holds query qi's head slice in rows [head*d, (head+1)*d))
     k_ref    (1, blk, h*d)   unrotated keys
@@ -110,6 +123,10 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
     per-head slicing), and softmax stats live in (1, h) rows that broadcast over
     sublanes — the orientations Mosaic lowers natively. The per-query loop is a
     trace-time Python unroll over static scratch rows (n_q <= 8).
+
+    Skipping dead blocks is exact: an all-masked block contributes prob = 0 and
+    rescales m/l/acc by exp(0) = 1, so omitting it leaves the flash state
+    bit-identical (tests/test_decode_kernel.py pins this).
     """
     import jax.experimental.pallas as pl
 
@@ -122,6 +139,7 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
     n_q = qbd_ref.shape[1] // h
     r = ang_ref.shape[2]
     d = hd // h
+    contract = (((1,), (0,)), ((), ()))
 
     @pl.when(i == 0)
     def _init():
@@ -129,41 +147,45 @@ def _kernel(qpos_ref, qbd_ref, k_ref, v_ref, ang_ref, pad_ref, rot_ref, exp_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    ang = ang_ref[0].astype(jnp.float32)  # (blk, r)
-    # tile [angles, identity-fill] across heads -> per-channel (blk, h*d)
-    fill = [jnp.ones((blk, d - r), jnp.float32)] if d > r else []
-    cos = jnp.concatenate(([jnp.cos(ang)] + fill) * h, -1)  # (blk, h*d)
-    sin = jnp.concatenate(([jnp.sin(ang)] + fill) * h, -1)
-
-    k = k_ref[0].astype(jnp.float32)  # (blk, h*d)
-    contract = (((1,), (0,)), ((), ()))
-    rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
-    k = k * cos + rot_half * sin
-
-    sc_all = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, n_q*h)
     q_last = qpos_ref[bi]
-    slot = i * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
-    not_pad = pad_ref[0].astype(jnp.int32) == 0  # (blk, 1)
-    vf = v_ref[0].astype(jnp.float32)
+    live_lo = q_last + 1 - live_ref[bi]  # first live slot (== pad count for full rows)
+    dead = jnp.maximum(live_lo // blk, 0)  # fully-dead head blocks
 
-    for qi in range(n_q):
-        sc = sc_all[:, qi * h : (qi + 1) * h]  # (blk, h)
-        visible = (slot <= q_last - (n_q - 1 - qi)) & not_pad  # (blk, 1)
-        sc = jnp.where(visible, sc, -jnp.inf)
+    @pl.when(i >= dead)
+    def _compute():
+        ang = ang_ref[0].astype(jnp.float32)  # (blk, r)
+        # tile [angles, identity-fill] across heads -> per-channel (blk, h*d)
+        fill = [jnp.ones((blk, d - r), jnp.float32)] if d > r else []
+        cos = jnp.concatenate(([jnp.cos(ang)] + fill) * h, -1)  # (blk, h*d)
+        sin = jnp.concatenate(([jnp.sin(ang)] + fill) * h, -1)
 
-        m_prev = m_ref[qi : qi + 1, :h]
-        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
-        prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (blk, h)
+        k = k_ref[0].astype(jnp.float32)  # (blk, h*d)
+        rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
+        k = k * cos + rot_half * sin
 
-        prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h*d)
-        pv = jnp.sum(prob_x * vf, axis=0, keepdims=True)  # (1, h*d)
-        scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (1, h*d)
+        sc_all = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, n_q*h)
+        slot = i * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
+        not_pad = (pad_ref[0].astype(jnp.int32) == 0) & (slot >= live_lo)  # (blk, 1)
+        vf = v_ref[0].astype(jnp.float32)
 
-        m_ref[qi : qi + 1, :h] = m_new
-        l_ref[qi : qi + 1, :h] = l_ref[qi : qi + 1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
-        acc_ref[qi : qi + 1, :] = acc_ref[qi : qi + 1, :] * scale_x + pv
+        for qi in range(n_q):
+            sc = sc_all[:, qi * h : (qi + 1) * h]  # (blk, h)
+            visible = (slot <= q_last - (n_q - 1 - qi)) & not_pad  # (blk, 1)
+            sc = jnp.where(visible, sc, -jnp.inf)
+
+            m_prev = m_ref[qi : qi + 1, :h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
+            safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
+            prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (blk, h)
+
+            prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (blk, h*d)
+            pv = jnp.sum(prob_x * vf, axis=0, keepdims=True)  # (1, h*d)
+            scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)  # (1, h*d)
+
+            m_ref[qi : qi + 1, :h] = m_new
+            l_ref[qi : qi + 1, :h] = l_ref[qi : qi + 1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
+            acc_ref[qi : qi + 1, :] = acc_ref[qi : qi + 1, :] * scale_x + pv
 
     @pl.when(i == nblocks - 1)
     def _finalize():
@@ -182,6 +204,7 @@ def fused_decode_attention_auto(
     rope_k: jax.Array,
     q_pos: jax.Array,
     pad_slots: jax.Array,
+    live: Optional[jax.Array] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Mesh-aware dispatch: under an ambient mesh that shards batch axes, the
@@ -192,7 +215,7 @@ def fused_decode_attention_auto(
 
     plan = _mesh_plan() if jax.device_count() > 1 else None
     if plan is None or not plan[0]:
-        return fused_decode_attention(q, k_cache, v_cache, rope_k, q_pos, pad_slots, interpret=interpret)
+        return fused_decode_attention(q, k_cache, v_cache, rope_k, q_pos, pad_slots, live=live, interpret=interpret)
 
     from jax.sharding import PartitionSpec as P
 
@@ -201,8 +224,14 @@ def fused_decode_attention_auto(
     b = q.shape[0]
     baxes = plan[0]
     q_pos_b = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    live_b = (
+        jnp.broadcast_to(jnp.asarray(live, jnp.int32).reshape(-1), (b,))
+        if live is not None else q_pos_b + 1  # full live region: no skipping
+    )
     fn = _shard_map(
-        lambda q, k, v, a, pos, pad: fused_decode_attention(q, k, v, a, pos, pad, interpret=interpret),
+        lambda q, k, v, a, pos, pad, lv: fused_decode_attention(
+            q, k, v, a, pos, pad, live=lv, interpret=interpret
+        ),
         in_specs=(
             P(baxes, None, None, None),
             P(baxes, None, None),
@@ -210,11 +239,12 @@ def fused_decode_attention_auto(
             P(baxes, None, None),
             P(baxes),
             P(baxes, None),
+            P(baxes),
         ),
         out_specs=P(baxes, None, None, None),
         mesh=None,
     )
-    return fn(q, k_cache, v_cache, rope_k, q_pos_b, pad_slots)
+    return fn(q, k_cache, v_cache, rope_k, q_pos_b, pad_slots, live_b)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -225,12 +255,17 @@ def fused_decode_attention(
     rope_k: jax.Array,
     q_pos: jax.Array,
     pad_slots: jax.Array,
+    live: Optional[jax.Array] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """q (B, H, n_q, D) scaled (+rotated) queries, n_q <= 8; k/v_cache
     (B, cap, H*D) unrotated; rope_k (B, cap, R) angles; q_pos () or (B,)
     absolute position of the LAST query (query qi sits at q_pos - (n_q-1-qi));
-    pad_slots (B, cap). Returns (B, H, n_q, D)."""
+    pad_slots (B, cap). ``live`` () or (B,): per-row live-entry counts — the
+    live region is the tail [q_pos+1-live, q_pos+1); KV blocks entirely below
+    it are skipped (no compute, no fresh DMA). Callers keep ``live``
+    consistent with ``pad_slots`` (live = valid minus pad slots); None means
+    fully live. Returns (B, H, n_q, D)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -241,20 +276,31 @@ def fused_decode_attention(
     r = rope_k.shape[-1]
 
     q_pos_arr = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    live_arr = (
+        jnp.broadcast_to(jnp.asarray(live, jnp.int32).reshape(-1), (b,))
+        if live is not None else q_pos_arr + 1  # full live region: no skipping
+    )
     # block-diagonal queries: column qi*h+head carries q[:, head, qi] in rows
     # [head*d, (head+1)*d)
     eye = jnp.eye(h, dtype=q.dtype)
     qbd = (q.transpose(0, 1, 3, 2)[:, :, :, :, None] * eye[:, None, None, :]).reshape(b, h * d, n_q * h)
 
+    def _kv_map(bi, i, qpos_ref, live_ref):
+        # dead head blocks alias the first (possibly) live block: consecutive
+        # equal indices elide the DMA, so HBM traffic scales with live tokens
+        # (clamped into range — live = 0 rows have no live block at all)
+        dead = jnp.maximum((qpos_ref[bi] + 1 - live_ref[bi]) // blk, 0)
+        return (bi, jnp.minimum(jnp.maximum(i, dead), nblocks - 1), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(b, nblocks),
         in_specs=[
             pl.BlockSpec((None, h * d, n_q * h), lambda bi, i, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
-            pl.BlockSpec((1, blk, h * d), lambda bi, i, *_: (bi, i, 0)),
-            pl.BlockSpec((1, blk, r), lambda bi, i, *_: (bi, i, 0)),
-            pl.BlockSpec((1, blk, 1), lambda bi, i, *_: (bi, i, 0)),
+            pl.BlockSpec((1, blk, h * d), _kv_map),
+            pl.BlockSpec((1, blk, h * d), _kv_map),
+            pl.BlockSpec((1, blk, r), _kv_map),
+            pl.BlockSpec((1, blk, 1), _kv_map),
             pl.BlockSpec((h * d, h * d), lambda bi, i, *_: (0, 0)),
             pl.BlockSpec((h, h * d), lambda bi, i, *_: (0, 0)),
         ],
@@ -272,6 +318,7 @@ def fused_decode_attention(
         interpret=interpret,
     )(
         q_pos_arr,
+        live_arr,
         qbd,
         k_cache,
         v_cache,

@@ -11,8 +11,9 @@ from perceiver_io_tpu.serving.engine import (
     ServedRequest,
     ServingEngine,
     SlotState,
+    default_prefill_buckets,
 )
-from perceiver_io_tpu.serving.metrics import EngineMetrics
+from perceiver_io_tpu.serving.metrics import EngineMetrics, load_metrics_jsonl
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
 __all__ = [
@@ -22,4 +23,6 @@ __all__ = [
     "ServingEngine",
     "SlotScheduler",
     "SlotState",
+    "default_prefill_buckets",
+    "load_metrics_jsonl",
 ]

@@ -12,20 +12,34 @@ Design (see docs/serving.md for the full writeup):
   * The engine owns ``num_slots`` decode slots stacked into one batched
     ``PerceiverARCache`` (batch axis = slot index). Cache lengths are shared
     scalars, so every slot must sit at the SAME fill level at all times: the
-    engine pins the whole pool at full capacity by prefilling every request
-    left-padded to the full window (``max_seq_len`` tokens, ``max_latents``
-    latents — the canonical form; per-request left-pad counts live in the
-    cache's ``shift``/``pad_slots`` fields exactly as for padded batches).
-  * Admission = one batch-1 prefill (ONE static shape, compiled once) + a
-    row scatter into the pool (``PerceiverARCache.write_slot``).
+    engine pins the whole pool at full capacity; per-request left-pad counts
+    live in the cache's ``shift``/``pad_slots``/``live`` fields exactly as
+    for padded batches.
+  * Admission = one batch-1 prefill at the smallest BUCKET covering the
+    prompt (a small geometric ladder of compiled shapes, ``prefill_buckets``
+    — prefill cost is O(bucket), not O(window)) + a row scatter into the
+    pool (``PerceiverARCache.write_slot`` widens the bucket rows into the
+    slot's tail). Compile count stays bounded: <= one prefill program per
+    bucket, pinned by test. Admission is NON-BLOCKING: prefill/install are
+    dispatched without a device sync so they overlap the decode stream, and
+    all free slots are filled before the tick's single sync point.
   * One jitted decode step advances ALL slots one token: per-slot sampling
     parameters are traced (B,) arrays (``process_logits_batched``), so any
     mix of greedy/temperature/top-k/top-p requests shares the one program.
     Free slots decode pad tokens whose outputs are discarded — compute is
-    wasted, recompilation never happens.
+    wasted, recompilation never happens. Per-slot live lengths ride in
+    ``PerceiverARCache.live`` so the decode kernel skips KV blocks below
+    each slot's live region (ragged length-aware decode,
+    ops/decode_kernel.py).
   * EOS/length bookkeeping is host-side: the scheduler evicts finished
     requests and admits queued ones between steps. ``max_new_tokens`` is a
     host counter, not a compiled loop bound, so mixed lengths are free.
+
+Kill-switches: ``PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL=1`` pins the
+ladder at the single full-window bucket (the PR-1 behavior);
+``PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1`` disables live-length masking
+and block skipping (pad masking alone);
+``PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL=1`` disables the fused kernel.
 
 Greedy engine output is token-identical to ``generate()`` on the same
 canonical form (tests/test_serving.py pins this in float64); sampled output
@@ -35,6 +49,7 @@ is reproducible per request seed but follows the engine's own key chain.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -128,9 +143,26 @@ def _engine_compatible(config: GenerationConfig) -> Optional[str]:
         return "chunked speculation shares one scalar commit length per batch"
     if config.max_new_tokens < 1:
         return "max_new_tokens must be >= 1"
-    if config.temperature <= 0.0:
-        return f"temperature must be > 0, got {config.temperature}"
+    # temperature is irrelevant under greedy decoding (argmax is invariant to
+    # positive scaling and the scaling is never applied): greedy requests with
+    # temperature <= 0 are admitted and installed with the neutral 1.0 encoding
+    if config.do_sample and config.temperature <= 0.0:
+        return f"temperature must be > 0 for sampling, got {config.temperature}"
     return None
+
+
+def default_prefill_buckets(window: int, max_latents: int) -> tuple:
+    """Geometric (halving) ladder of prefill bucket lengths, from the full
+    window down to the smallest bucket that still fits ``max_latents`` latents
+    (prefill at bucket L uses ``prefix_len = L - max_latents``, so L >=
+    max_latents). Ascending order; always contains ``window``."""
+    floor = max(max_latents, 1)
+    buckets = [window]
+    b = window
+    while b // 2 >= floor:
+        b //= 2
+        buckets.append(b)
+    return tuple(sorted(buckets))
 
 
 class ServingEngine:
@@ -148,6 +180,7 @@ class ServingEngine:
         num_slots: int = 4,
         cache_dtype=None,
         metrics_jsonl: Optional[str] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
     ):
         self.model = model
         self.params = params
@@ -164,12 +197,35 @@ class ServingEngine:
         self._window = model.max_seq_len
         self._prefix_len = model.max_prefix_len
 
+        # Prefill bucket ladder (ascending, ends at the window): a prompt is
+        # prefilled at the smallest covering bucket — cost O(bucket) — and
+        # write_slot widens the bucket rows into the slot's tail. One compiled
+        # prefill program per bucket, ever.
+        disable = os.environ.get(
+            "PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL", "0"
+        ).lower() not in ("0", "false", "")
+        if prefill_buckets is None:
+            ladder = default_prefill_buckets(self._window, model.max_latents)
+        else:
+            ladder = tuple(sorted({int(b) for b in prefill_buckets} | {self._window}))
+            bad = [b for b in ladder if not model.max_latents <= b <= self._window]
+            if bad:
+                raise ValueError(
+                    f"prefill_buckets must lie in [max_latents={model.max_latents}.."
+                    f"window={self._window}], got {bad}"
+                )
+        self.prefill_buckets: tuple = (self._window,) if disable else ladder
+
         # Device pool: batched cache pinned at FULL capacity (free slots hold
-        # zeros — harmless; see module docstring) + per-slot state.
+        # zeros — harmless; see module docstring) + per-slot state. Free-slot
+        # live lengths are pinned at the full window so the ragged decode
+        # kernel treats them exactly like the pre-ragged path (outputs
+        # discarded either way).
         cache = model.init_cache(batch_size=num_slots, dtype=self.cache_dtype)
         self._cache = cache.replace(
             ca=cache.ca.replace(length=jnp.asarray(cache.ca.capacity, jnp.int32)),
             sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
+            live=jnp.full((num_slots,), cache.ca.capacity, jnp.int32),
         )
         # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
         # serving); storing them narrower would silently cast at install
@@ -179,14 +235,20 @@ class ServingEngine:
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
         """Per-engine jit wrappers so ``_cache_size()`` counts THIS engine's
-        compilations (the churn test asserts decode compiles exactly once)."""
-        model, dtype, prefix_len = self.model, self.cache_dtype, self._prefix_len
+        compilations (the churn test asserts decode compiles exactly once and
+        prefill compiles at most once per bucket)."""
+        model, dtype = self.model, self.cache_dtype
+        n_latents = model.max_latents
 
-        @jax.jit
-        def prefill_one(params, ids, pad_mask):
-            cache = model.init_cache(batch_size=1, dtype=dtype)
+        @partial(jax.jit, static_argnames=("bucket",))
+        def prefill_one(params, ids, pad_mask, bucket):
+            # bucket-capacity cross-attention cache: prefill cost is
+            # O(bucket), and the bucket always yields exactly max_latents
+            # latents (prefix_len = bucket - max_latents) so the pool's
+            # shared self-attention length stays uniform
+            cache = model.init_cache(batch_size=1, dtype=dtype, max_seq_len=bucket)
             logits, cache = model.apply(
-                params, ids, prefix_len, cache, pad_mask=pad_mask, method=type(model).prefill
+                params, ids, bucket - n_latents, cache, pad_mask=pad_mask, method=type(model).prefill
             )
             return logits[:, -1], cache
 
@@ -216,13 +278,17 @@ class ServingEngine:
             # reset sampling fields to their neutral encodings: a stale
             # do_sample/top_k/top_p on a freed row would keep the decode
             # step's any-row lax.cond branches (sampling.py) live and make
-            # all-greedy batches pay the vocab sorts forever
+            # all-greedy batches pay the vocab sorts forever. rng/next_logits
+            # are zeroed too so freed-slot state is canonical and pool dumps
+            # are reproducible (they never feed a harvested output).
             return state.replace(
                 active=state.active.at[slot].set(False),
                 do_sample=state.do_sample.at[slot].set(False),
                 temperature=state.temperature.at[slot].set(1.0),
                 top_k=state.top_k.at[slot].set(0),
                 top_p=state.top_p.at[slot].set(1.0),
+                rng=state.rng.at[slot].set(0),
+                next_logits=state.next_logits.at[slot].set(0),
             )
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -239,7 +305,13 @@ class ServingEngine:
             logits_t, cache = model.apply(
                 params, tok[:, None], cache, method=type(model).decode_step
             )
-            state = state.replace(next_logits=logits_t[:, -1], rng=keys[:, 0])
+            # inactive rows keep their (zeroed-at-release) rng/logits frozen:
+            # freed-slot state stays canonical across steps, so pool dumps are
+            # reproducible regardless of how long slots idle between requests
+            state = state.replace(
+                next_logits=jnp.where(state.active[:, None], logits_t[:, -1], state.next_logits),
+                rng=jnp.where(state.active[:, None], keys[:, 0], state.rng),
+            )
             return tok, cache, state
 
         self._jit_prefill = prefill_one
@@ -251,6 +323,11 @@ class ServingEngine:
     def decode_compilations(self) -> int:
         """Number of programs compiled for the decode step (target: 1)."""
         return self._jit_decode._cache_size()
+
+    @property
+    def prefill_compilations(self) -> int:
+        """Number of compiled prefill programs (target: <= len(prefill_buckets))."""
+        return self._jit_prefill._cache_size()
 
     # ------------------------------------------------------------------ submit
     def submit(
@@ -291,37 +368,54 @@ class ServingEngine:
         return request
 
     # ------------------------------------------------------------------- admit
-    def _canonical_prompt(self, request: ServedRequest):
-        """Left-pad the prompt to the full window (the engine's one prefill
-        shape); pad positions are masked and position-shifted exactly as in
-        the padded-batch pipeline path."""
+    def _bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket covering an n-token prompt."""
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise AssertionError(f"no bucket covers length {n}")  # submit() bounds n <= window
+
+    def _bucket_prompt(self, request: ServedRequest, bucket: int):
+        """Left-pad the prompt to its covering bucket; pad positions are masked
+        and position-shifted exactly as in the padded-batch pipeline path, and
+        ``write_slot`` grows the left-pad to the full window at install."""
         n = request.prompt_ids.size
-        ids = np.full((1, self._window), request.config.pad_token_id, np.int32)
-        pad = np.ones((1, self._window), bool)
-        ids[0, self._window - n:] = request.prompt_ids
-        pad[0, self._window - n:] = False
+        ids = np.full((1, bucket), request.config.pad_token_id, np.int32)
+        pad = np.ones((1, bucket), bool)
+        ids[0, bucket - n:] = request.prompt_ids
+        pad[0, bucket - n:] = False
         return jnp.asarray(ids), jnp.asarray(pad)
 
     def _admit(self, slot: int, request: ServedRequest) -> None:
         cfg = request.config
         t0 = time.perf_counter()
-        ids, pad_mask = self._canonical_prompt(request)
-        req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask)
+        bucket = self._bucket_for(request.prompt_ids.size)
+        ids, pad_mask = self._bucket_prompt(request, bucket)
+        req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
         self._cache, self._state = self._jit_install(
             self._cache, self._state, slot, req_cache, req_logits, request.rng,
-            float(cfg.temperature),
-            int(cfg.top_k) if cfg.top_k else 0,
-            float(cfg.top_p) if cfg.top_p is not None else 1.0,
+            # greedy requests ignore temperature/top_k/top_p (argmax survives
+            # scaling and filtering): install the neutral encodings so any
+            # user value — including temperature <= 0 — shares the one
+            # compiled step, and a greedy slot never keeps the batch-wide
+            # vocab-sort filter branches live (see _jit_release)
+            float(cfg.temperature) if cfg.do_sample else 1.0,
+            int(cfg.top_k) if (cfg.do_sample and cfg.top_k) else 0,
+            float(cfg.top_p) if (cfg.do_sample and cfg.top_p is not None) else 1.0,
             bool(cfg.do_sample),
             int(cfg.pad_token_id),
         )
-        jax.block_until_ready(self._state.next_logits)
+        # NON-BLOCKING: no device sync here — the prefill/install dispatch
+        # overlaps the decode stream, and step() syncs once per tick (its
+        # np.asarray on the decoded tokens). prefill_s is therefore dispatch
+        # time; device prefill cost lands in the next decode_step sync.
         now = time.perf_counter()
         request.status = RequestStatus.RUNNING
         request.slot = slot
         request.admitted_at = now
         self.metrics.record_admit(
-            request.request_id, slot, wait_s=now - request.submitted_at, prefill_s=now - t0
+            request.request_id, slot, wait_s=now - request.submitted_at,
+            prefill_s=now - t0, bucket=bucket,
         )
 
     def _evict(self, slot: int, request: ServedRequest, reason: str) -> None:

@@ -1,7 +1,8 @@
 """Engine observability: counters, timers, and a JSONL event log.
 
-The metrics layer is deliberately framework-free (a dict + an append-only
-JSONL file) so bench drivers can pin numbers without scraping stdout:
+The metrics layer is deliberately jax-free (a dict + an append-only
+JSONL file, numpy only for percentiles) so bench drivers can pin numbers
+without scraping stdout:
 ``scripts/serve_bench.py`` embeds ``EngineMetrics.snapshot()`` verbatim in
 its artifact, and ``docs/serving.md`` documents the schema.
 
@@ -11,16 +12,93 @@ Two throughput views are reported because they answer different questions:
   * ``wall_tokens_per_s``    — useful tokens per second of wall clock between
     the first submit and the snapshot (what a client actually observes,
     including prefill, scheduling, and host bookkeeping).
+
+Schema history:
+  * ``serving-metrics/v1`` — counters + ``queue_wait_s.{mean,max}``.
+  * ``serving-metrics/v2`` — adds p50/p95 latency percentiles for queue wait,
+    prefill dispatch, and decode step (``queue_wait_s``/``prefill_s``/
+    ``decode_step_s`` sub-dicts; ALL latency stats incl. mean/max cover the
+    most recent ``LATENCY_WINDOW`` events, where v1's mean/max were
+    lifetime) and a per-admission ``bucket`` field on ``admit`` events (the
+    bucketed-prefill ladder). With non-blocking
+    admission ``prefill_s`` measures DISPATCH time — device prefill cost
+    lands in the next decode-step sync. ``load_metrics_jsonl`` reads both
+    versions (v1 snapshots are normalized with ``None`` percentiles).
 """
 
 from __future__ import annotations
 
 import json
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
-SCHEMA = "serving-metrics/v1"
+import numpy as np
+
+SCHEMA = "serving-metrics/v2"
+KNOWN_SCHEMAS = ("serving-metrics/v1", "serving-metrics/v2")
+
+_PERCENTILE_KEYS = ("p50", "p95")
+
+# Latency histories are bounded ring buffers: a long-lived engine records one
+# decode-step sample per generated token forever, so unbounded lists would be
+# a slow host-memory leak and snapshot() would sort ever-growing history. ALL
+# latency statistics (mean/max/p50/p95) therefore describe the most recent
+# window — v1's mean/max were lifetime — while the scalar counters
+# (requests, tokens, *_seconds) remain lifetime totals.
+LATENCY_WINDOW = 4096
+
+
+def _latency_dict(xs) -> Dict[str, float]:
+    if not xs:
+        return {"mean": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0}
+    arr = list(xs)
+    p50, p95 = np.percentile(arr, [50, 95])
+    return {
+        "mean": round(sum(arr) / len(arr), 6),
+        "max": round(max(arr), 6),
+        "p50": round(float(p50), 6),
+        "p95": round(float(p95), 6),
+    }
+
+
+def load_metrics_jsonl(path: str) -> Dict:
+    """Version-tolerant reader for engine JSONL logs.
+
+    Returns ``{"events": [...], "snapshots": [...]}`` where every snapshot is
+    normalized to the v2 shape: v1 snapshots (no percentile sub-dicts) get
+    ``prefill_s``/``decode_step_s`` filled with ``None`` values and their
+    ``queue_wait_s`` dict extended with ``p50: None, p95: None``. Unknown
+    schema strings raise ``ValueError`` (corrupt/foreign files fail loudly,
+    missing fields of known versions do not)."""
+    events: List[Dict] = []
+    snapshots: List[Dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            record = json.loads(line)
+            events.append(record)
+            if record.get("event") != "snapshot":
+                continue
+            schema = record.get("schema")
+            if schema not in KNOWN_SCHEMAS:
+                raise ValueError(f"unknown metrics schema {schema!r} in {path}")
+            snap = dict(record)
+            if schema == "serving-metrics/v1":
+                wait = dict(snap.get("queue_wait_s") or {})
+                for k in _PERCENTILE_KEYS:
+                    wait.setdefault(k, None)
+                wait.setdefault("mean", None)
+                wait.setdefault("max", None)
+                snap["queue_wait_s"] = wait
+                none_lat = {"mean": None, "max": None, "p50": None, "p95": None}
+                snap.setdefault("prefill_s", dict(none_lat))
+                snap.setdefault("decode_step_s", dict(none_lat))
+            snapshots.append(snap)
+    return {"events": events, "snapshots": snapshots}
 
 
 @dataclass
@@ -41,7 +119,9 @@ class EngineMetrics:
     queue_depth: int = 0
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
-    _queue_waits: List[float] = field(default_factory=list)
+    _queue_waits: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _prefill_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _decode_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _jsonl_file: Optional[object] = field(default=None, repr=False)
 
     # ------------------------------------------------------------------ events
@@ -63,20 +143,26 @@ class EngineMetrics:
         self.queue_depth += 1
         self._emit("submit", request_id=request_id, prompt_len=prompt_len)
 
-    def record_admit(self, request_id: int, slot: int, wait_s: float, prefill_s: float) -> None:
+    def record_admit(
+        self, request_id: int, slot: int, wait_s: float, prefill_s: float,
+        bucket: Optional[int] = None,
+    ) -> None:
         self.requests_admitted += 1
         self.prefills += 1
         self.prefill_seconds += prefill_s
         self.queue_depth = max(self.queue_depth - 1, 0)
         self._queue_waits.append(wait_s)
+        self._prefill_times.append(prefill_s)
+        extra = {} if bucket is None else {"bucket": bucket}
         self._emit("admit", request_id=request_id, slot=slot,
-                   wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6))
+                   wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6), **extra)
 
     def record_decode_step(self, active_slots: int, seconds: float, tokens: int) -> None:
         self.decode_steps += 1
         self.decode_seconds += seconds
         self.tokens_generated += tokens
         self._occupancy_sum += active_slots / max(self.num_slots, 1)
+        self._decode_times.append(seconds)
         self._emit("decode_step", active_slots=active_slots,
                    seconds=round(seconds, 6), tokens=tokens)
 
@@ -88,7 +174,6 @@ class EngineMetrics:
     # ---------------------------------------------------------------- snapshot
     def snapshot(self) -> Dict:
         wall = (time.perf_counter() - self._start_time) if self._start_time else 0.0
-        waits = self._queue_waits
         snap = {
             "schema": SCHEMA,
             "num_slots": self.num_slots,
@@ -107,10 +192,9 @@ class EngineMetrics:
             "wall_tokens_per_s": round(self.tokens_generated / wall, 3) if wall > 0 else 0.0,
             "mean_slot_occupancy": round(self._occupancy_sum / self.decode_steps, 4)
             if self.decode_steps > 0 else 0.0,
-            "queue_wait_s": {
-                "mean": round(sum(waits) / len(waits), 6) if waits else 0.0,
-                "max": round(max(waits), 6) if waits else 0.0,
-            },
+            "queue_wait_s": _latency_dict(self._queue_waits),
+            "prefill_s": _latency_dict(self._prefill_times),
+            "decode_step_s": _latency_dict(self._decode_times),
         }
         return snap
 

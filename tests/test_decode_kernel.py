@@ -131,6 +131,64 @@ def test_cached_multi_token_attention_with_kernel_matches_plain(monkeypatch):
     np.testing.assert_allclose(fused, plain, atol=2e-5)
 
 
+def test_ragged_live_skip_matches_masked_fallback_interpret():
+    """Acceptance (ragged decode): with per-row live lengths whose dead region
+    equals the pad-slot head, the block-skipping kernel is (a) BIT-identical to
+    the pad-masked kernel without live lengths (skipped blocks contribute
+    prob=0 / scale=1 to the flash state) and (b) matches the XLA masked-softmax
+    reference that applies the same per-row bound — in interpret mode on CPU."""
+    b, h, d, cap, r = 3, 2, 32, 1024, 16  # blk = 512 -> 2 blocks; rows skip 0/1/2 whole blocks
+    rng = lambda i: jax.random.PRNGKey(i)
+    q = jax.random.normal(rng(0), (b, h, 1, d)) * 0.3
+    k = jax.random.normal(rng(1), (b, cap, h * d)) * 0.3
+    v = jax.random.normal(rng(2), (b, cap, h * d)) * 0.3
+    ang = jnp.repeat(jax.random.normal(rng(3), (b, cap, r // 2)) * 0.5, 2, axis=-1)
+    # dead heads: row 0 none, row 1 straddles block 0 (600 pads), row 2 all but the tail
+    pads = [0, 600, 1000]
+    pad = np.zeros((b, cap), bool)
+    for i, p in enumerate(pads):
+        pad[i, :p] = True
+    pad = jnp.asarray(pad)
+    q_pos = jnp.full((b,), cap - 1, jnp.int32)
+    live = jnp.asarray([cap - p for p in pads], jnp.int32)
+
+    out_live = dk.fused_decode_attention(q, k, v, ang, q_pos, pad, live=live, interpret=True)
+    out_mask = dk.fused_decode_attention(q, k, v, ang, q_pos, pad, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_live), np.asarray(out_mask))  # bit-identical
+    ref = xla_reference(q, k, v, ang, q_pos, pad)
+    np.testing.assert_allclose(np.asarray(out_live), np.asarray(ref), atol=1e-5)
+
+
+def test_ragged_live_bound_masks_without_pad_mask_interpret():
+    """The kernel applies the live lower bound in its score mask too (not only
+    via block skipping), so live alone — no pad mask — matches the fallback's
+    per-row bound, including mid-block boundaries."""
+    b, h, d, cap, r = 2, 2, 16, 256, 8
+    rng = lambda i: jax.random.PRNGKey(i)
+    q = jax.random.normal(rng(0), (b, h, 1, d)) * 0.3
+    k = jax.random.normal(rng(1), (b, cap, h * d)) * 0.3
+    v = jax.random.normal(rng(2), (b, cap, h * d)) * 0.3
+    ang = jnp.repeat(jax.random.normal(rng(3), (b, cap, r // 2)) * 0.5, 2, axis=-1)
+    no_pad = jnp.zeros((b, cap), bool)
+    q_pos = jnp.full((b,), cap - 1, jnp.int32)
+    live = jnp.asarray([cap - 37, cap], jnp.int32)  # mid-block dead head vs fully live
+
+    out = dk.fused_decode_attention(q, k, v, ang, q_pos, no_pad, live=live, interpret=True)
+    # reference: the live bound expressed as a pad mask
+    pad = np.zeros((b, cap), bool)
+    pad[0, :37] = True
+    ref = xla_reference(q, k, v, ang, q_pos, jnp.asarray(pad))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ragged_decode_kill_switch(monkeypatch):
+    """PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE drops live-length masking back to
+    pad masking alone (ragged_decode_enabled gates the kv_live plumbing)."""
+    assert dk.ragged_decode_enabled()
+    monkeypatch.setenv("PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE", "1")
+    assert not dk.ragged_decode_enabled()
+
+
 def test_decode_kernel_supported_gates():
     import os
 

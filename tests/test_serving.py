@@ -75,6 +75,46 @@ def test_greedy_engine_matches_generate_mixed_lengths(x64):
         assert handle.finish_reason == "length"
 
 
+def test_bucketed_prefill_parity_at_bucket_boundaries(x64):
+    """Acceptance: greedy engine output stays token-identical to generate()'s
+    canonical full-window form for prompt lengths straddling EVERY bucket
+    boundary of the ladder (1, bucket, bucket + 1, window), in float64 — the
+    bucketed-prefill + write_slot tail-scatter must be positionally invisible."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    engine = ServingEngine(model, params, num_slots=2)
+    assert engine.prefill_buckets == (LATENTS, WINDOW)  # the default halving ladder
+    lengths = sorted({1, *(n for b in engine.prefill_buckets for n in (b, min(b + 1, WINDOW))), WINDOW})
+    prompts = [list(range(3, 3 + n)) for n in lengths]
+    handles = [engine.submit(p, max_new_tokens=4) for p in prompts]
+    engine.run_until_drained(max_steps=300)
+    for handle, prompt in zip(handles, prompts):
+        expected = _reference_tokens(model, params, prompt, GenerationConfig(max_new_tokens=4))
+        assert handle.result().tolist() == expected, f"len {len(prompt)} diverged"
+    # every admission compiled at most one program per bucket
+    assert engine.prefill_compilations <= len(engine.prefill_buckets)
+
+
+def test_bucketed_prefill_kill_switch_matches_bucketed(x64, monkeypatch):
+    """PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL pins the single-window ladder
+    and (greedy, float64) produces the same tokens as the bucketed engine."""
+    model, params = _make_model(param_dtype=jnp.float64)
+
+    def run(disable):
+        if disable:
+            monkeypatch.setenv("PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL", "1")
+        else:
+            monkeypatch.delenv("PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL", raising=False)
+        engine = ServingEngine(model, params, num_slots=2)
+        handles = [engine.submit(p, max_new_tokens=4) for p in ([5, 6, 7], list(range(40, 49)))]
+        engine.run_until_drained(max_steps=100)
+        return [h.result().tolist() for h in handles], engine.prefill_buckets
+
+    bucketed, ladder = run(False)
+    pinned, single = run(True)
+    assert bucketed == pinned
+    assert len(ladder) > 1 and single == (WINDOW,)
+
+
 def test_eos_early_stop_matches_generate(x64):
     """EOS parity: the engine emits exactly generate()'s tokens up to and
     including EOS, then frees the slot (finish_reason='eos')."""
@@ -142,8 +182,11 @@ def test_scheduler_churn_compiles_decode_once(setup):
     assert engine.scheduler.active_slots == 0 and engine.scheduler.queue_depth == 0
     # THE tentpole invariant: request churn never recompiled the decode step
     assert engine.decode_compilations == 1
-    # and the one prefill program served every admission
-    assert engine._jit_prefill._cache_size() == 1
+    # and the prefill/install compile count stays bounded by the bucket ladder
+    # (the lengths above straddle every bucket, so every rung gets exercised)
+    assert {engine._bucket_for(n) for n in lengths} == set(engine.prefill_buckets)
+    assert engine.prefill_compilations <= len(engine.prefill_buckets)
+    assert engine._jit_install._cache_size() <= len(engine.prefill_buckets)
 
 
 def test_scheduler_fifo_and_slot_reuse():
@@ -177,6 +220,60 @@ def test_submit_validation(setup):
         engine.submit([1, 2], config=GenerationConfig(max_new_tokens=2, decode_chunk=4))
     with pytest.raises(ValueError, match="config or keyword"):
         engine.submit([1, 2], config=GenerationConfig(), max_new_tokens=2)
+    # sampling still requires a positive temperature
+    with pytest.raises(ValueError, match="temperature"):
+        engine.submit([1, 2], config=GenerationConfig(max_new_tokens=2, do_sample=True, temperature=0.0))
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        ServingEngine(model, params, num_slots=1, prefill_buckets=[2])  # < max_latents
+
+
+def test_greedy_temperature_zero_served_and_neutral(setup):
+    """Satellite: temperature <= 0 is irrelevant under greedy decoding — the
+    request is admitted (not rejected) and decodes identically to the default
+    temperature (the neutral 1.0 encoding is installed)."""
+    model, params = setup
+    engine = ServingEngine(model, params, num_slots=2)
+    h_zero = engine.submit([5, 6, 7], config=GenerationConfig(max_new_tokens=5, temperature=0.0))
+    h_neg = engine.submit([5, 6, 7], config=GenerationConfig(max_new_tokens=5, temperature=-1.5))
+    h_ref = engine.submit([5, 6, 7], max_new_tokens=5)
+    engine.run_until_drained(max_steps=100)
+    assert h_zero.result().tolist() == h_neg.result().tolist() == h_ref.result().tolist()
+    # generate() agrees: the same config decodes on BOTH paths (the pipeline
+    # routes by batch size, so engine and direct behavior must not diverge)
+    out_zero = _reference_tokens(model, params, [5, 6, 7],
+                                 GenerationConfig(max_new_tokens=5, temperature=0.0))
+    out_one = _reference_tokens(model, params, [5, 6, 7], GenerationConfig(max_new_tokens=5))
+    assert out_zero == out_one
+    # greedy also neutralizes top_k/top_p at install (argmax survives the
+    # filters, and a greedy slot must not keep the batch-wide vocab-sort
+    # branches of process_logits_batched live)
+    h = engine.submit([5, 6, 7], config=GenerationConfig(max_new_tokens=2, top_k=50, top_p=0.9))
+    engine.step()
+    slot = h.slot
+    assert int(np.asarray(engine._state.top_k)[slot]) == 0
+    assert float(np.asarray(engine._state.top_p)[slot]) == 1.0
+    engine.run_until_drained(max_steps=50)
+    assert h.result().tolist()[:2] == h_ref.result().tolist()[:2]
+
+
+def test_release_zeroes_freed_slot_state(setup):
+    """Satellite: a freed slot's rng and next_logits rows are zeroed (with the
+    sampling fields already neutral) so pool dumps are reproducible."""
+    model, params = setup
+    engine = ServingEngine(model, params, num_slots=2)
+    h = engine.submit([3, 1, 4], config=GenerationConfig(max_new_tokens=3, do_sample=True,
+                                                         temperature=0.7, top_k=9),
+                      rng=jax.random.PRNGKey(11))
+    engine.run_until_drained(max_steps=50)
+    assert h.done
+    state = engine._state
+    assert not bool(state.active.any())
+    assert np.asarray(state.rng).sum() == 0
+    assert np.asarray(state.next_logits).sum() == 0
+    assert np.asarray(state.do_sample).sum() == 0
+    np.testing.assert_array_equal(np.asarray(state.temperature), 1.0)
+    np.testing.assert_array_equal(np.asarray(state.top_k), 0)
+    np.testing.assert_array_equal(np.asarray(state.top_p), 1.0)
 
 
 # ----------------------------------------------------------------- metrics
@@ -209,13 +306,70 @@ def test_metrics_snapshot_schema_and_jsonl(setup, tmp_path):
 def test_metrics_standalone_counters():
     m = EngineMetrics(num_slots=4)
     m.record_submit(0, prompt_len=5)
-    m.record_admit(0, slot=1, wait_s=0.5, prefill_s=0.1)
+    m.record_admit(0, slot=1, wait_s=0.5, prefill_s=0.1, bucket=8)
     m.record_decode_step(active_slots=2, seconds=0.2, tokens=2)
     m.record_finish(0, slot=1, new_tokens=1, reason="length")
     snap = m.snapshot()
+    assert snap["schema"] == "serving-metrics/v2"
     assert snap["mean_slot_occupancy"] == 0.5
     assert snap["tokens_generated"] == 2 and snap["decode_steps"] == 1
-    assert snap["queue_wait_s"] == {"mean": 0.5, "max": 0.5}
+    assert snap["queue_wait_s"] == {"mean": 0.5, "max": 0.5, "p50": 0.5, "p95": 0.5}
+    assert snap["prefill_s"] == {"mean": 0.1, "max": 0.1, "p50": 0.1, "p95": 0.1}
+    assert snap["decode_step_s"] == {"mean": 0.2, "max": 0.2, "p50": 0.2, "p95": 0.2}
+
+
+def test_metrics_percentiles_over_population():
+    """p50/p95 follow numpy.percentile's linear-interpolation semantics over
+    the per-event populations."""
+    import numpy as _np
+
+    m = EngineMetrics(num_slots=2)
+    waits = [0.1, 0.4, 0.2, 0.9, 0.3]
+    for i, w in enumerate(waits):
+        m.record_submit(i, prompt_len=1)
+        m.record_admit(i, slot=0, wait_s=w, prefill_s=w / 10)
+    snap = m.snapshot()
+    assert snap["queue_wait_s"]["p50"] == pytest.approx(float(_np.percentile(waits, 50)), abs=1e-6)
+    assert snap["queue_wait_s"]["p95"] == pytest.approx(float(_np.percentile(waits, 95)), abs=1e-6)
+    assert snap["prefill_s"]["p95"] <= snap["prefill_s"]["max"]
+
+
+def test_metrics_jsonl_reader_tolerates_v1(tmp_path):
+    """Satellite: the version-tolerant reader returns v2 snapshots verbatim and
+    normalizes v1 snapshots (missing percentile dicts filled with None);
+    unknown schemas fail loudly."""
+    from perceiver_io_tpu.serving import load_metrics_jsonl
+
+    v1 = tmp_path / "v1.jsonl"
+    v1.write_text(
+        json.dumps({"event": "submit", "ts": 1.0, "request_id": 0, "prompt_len": 3}) + "\n"
+        + json.dumps({"event": "snapshot", "ts": 2.0, "schema": "serving-metrics/v1",
+                      "num_slots": 2, "tokens_generated": 5,
+                      "queue_wait_s": {"mean": 0.1, "max": 0.2}}) + "\n"
+    )
+    got = load_metrics_jsonl(str(v1))
+    assert len(got["events"]) == 2 and len(got["snapshots"]) == 1
+    snap = got["snapshots"][0]
+    assert snap["tokens_generated"] == 5
+    assert snap["queue_wait_s"] == {"mean": 0.1, "max": 0.2, "p50": None, "p95": None}
+    assert snap["prefill_s"]["p95"] is None and snap["decode_step_s"]["p50"] is None
+
+    v2 = tmp_path / "v2.jsonl"
+    m = EngineMetrics(num_slots=2, jsonl_path=str(v2))
+    m.record_submit(0, prompt_len=3)
+    m.record_admit(0, slot=0, wait_s=0.5, prefill_s=0.1, bucket=4)
+    m.write_snapshot()
+    m.close()
+    got2 = load_metrics_jsonl(str(v2))
+    assert got2["snapshots"][0]["schema"] == SCHEMA
+    assert got2["snapshots"][0]["queue_wait_s"]["p95"] == 0.5
+    admits = [e for e in got2["events"] if e["event"] == "admit"]
+    assert admits[0]["bucket"] == 4
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"event": "snapshot", "schema": "something/v9"}) + "\n")
+    with pytest.raises(ValueError, match="unknown metrics schema"):
+        load_metrics_jsonl(str(bad))
 
 
 # -------------------------------------------------------------- serve_bench
@@ -247,7 +401,39 @@ def test_serve_bench_smoke(tmp_path, monkeypatch):
     assert on_disk["baseline_single_request"]["tokens_per_s"] > 0
     assert "engine_vs_baseline" in on_disk
     assert result["engine"]["decode_compilations"] == 1
+    assert result["engine"]["prefill_compilations"] <= len(result["engine"]["prefill_buckets"])
+    assert result["engine"]["decode_tokens_per_s"] > 0  # prefill/decode split reported
+    assert result["engine"]["admission_prompt_tokens_per_s"] > 0
     assert log.exists() and log.read_text().strip()
+
+
+@pytest.mark.slow  # ~30 s of compiles: 4 engines (2 arms x 2 workloads)
+def test_serve_bench_profile_smoke(tmp_path):
+    """--profile emits BENCH_serving.json with per-workload bucketed vs
+    full-window admission/decode throughput splits (the per-PR perf artifact)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_profile_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "serve_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = tmp_path / "BENCH_serving.json"
+    result = mod.main(["--profile", "--preset", "tiny", "--requests", "3",
+                       "--slots", "2", "--profile-out", str(out)])
+    on_disk = json.loads(out.read_text())
+    assert set(on_disk["workloads"]) == {"short", "fullwindow"}
+    for w in on_disk["workloads"].values():
+        for arm in ("bucketed", "fullwindow_baseline"):
+            assert w[arm]["admission"]["prompt_tokens_per_s"] > 0
+            assert w[arm]["decode"]["decode_tokens_per_s"] > 0
+            assert w[arm]["prefill_compilations"] <= len(w[arm]["prefill_buckets"])
+        assert w["admission_speedup"] > 0
+    # the baseline arm pins the single full-window bucket (tiny preset: 64)
+    assert result["workloads"]["fullwindow"]["fullwindow_baseline"]["prefill_buckets"] == [64]
 
 
 # ---------------------------------------------------------------- pipeline
