@@ -1,1 +1,2 @@
 from perceiver_io_tpu.data.loader import DataLoader
+from perceiver_io_tpu.data.prefetch import DevicePrefetcher

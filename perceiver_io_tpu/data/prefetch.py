@@ -1,0 +1,166 @@
+"""Double-buffered device prefetch: overlap host input work with device compute.
+
+The fit loop used to block every step on host collate + ``jax.device_put``
+before it could dispatch (training/fit.py): on an input-bound workload the
+device idles for the whole host portion of every step. ``DevicePrefetcher``
+moves that work onto a background thread — while step N runs on device, the
+thread collates batches N+1..N+depth and places them (``jax.device_put``, or a
+``batch_sharding(mesh)`` placement via the ``put`` argument) into a bounded
+queue, so the step loop's next dispatch finds its batch already on device.
+
+Exact-resume contract (data/loader.py's guarantee must survive prefetching):
+the worker runs AHEAD of the trainer, so the wrapped loader's own
+``state_dict()`` over-counts by the in-flight depth at any instant. The worker
+therefore snapshots the loader's state immediately after fetching each batch
+and pairs it with that batch in the queue; ``state_dict()`` returns the
+snapshot paired with the last batch actually YIELDED to the trainer. A restore
+from that snapshot replays precisely the next unseen-by-the-trainer batch —
+in-flight batches are neither skipped nor repeated — and dataset-side
+augmentation RNGs are captured at the matching position (they advance per
+FETCHED example, which is exactly what the per-fetch snapshot freezes).
+
+Lifecycle: one worker thread per epoch (``__iter__``), non-daemon and named
+``perceiver-prefetch-*``. The thread always joins — on normal epoch
+exhaustion, on ``shutdown()``, on a consumer-side break/exception (the
+generator's ``finally``), and worker-side exceptions are re-raised in the
+consumer after the batches fetched before the failure have been delivered.
+
+Kill-switch: the trainer skips wrapping entirely when
+``PERCEIVER_IO_TPU_DISABLE_PREFETCH`` is set (see training/fit.py) — this
+module has no env-sensitive behavior of its own.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+
+_DONE = object()
+
+
+class _Failure:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Wrap a loader so batches are collated and device-placed ``depth`` ahead.
+
+    ``source``: any iterable of batches; re-iterated once per ``__iter__`` (the
+    per-epoch contract of data/loader.py). If it carries ``state_dict`` /
+    ``load_state_dict``, the prefetcher preserves exact mid-epoch resume.
+    ``put``: host batch -> device batch; defaults to ``jax.device_put`` (local
+    devices). Mesh training passes ``make_batch_put(mesh)`` (parallel/api.py)
+    so batches land sharded over the data axes.
+    """
+
+    def __init__(self, source: Iterable, depth: int = 2, put: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.source = source
+        self.depth = depth
+        self._put = put if put is not None else jax.device_put
+        self._stateful = hasattr(source, "state_dict")
+        self._resume_state: Optional[Dict] = None
+        self._worker: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._queue: Optional[queue.Queue] = None
+
+    def __len__(self) -> int:
+        return len(self.source)  # type: ignore[arg-type]
+
+    # ------------------------------------------------------------- resume state
+
+    def state_dict(self) -> Dict:
+        """The wrapped loader's state as of the last batch YIELDED to the
+        consumer (not the last batch fetched by the worker)."""
+        if not self._stateful:
+            raise TypeError(f"wrapped loader {type(self.source).__name__} has no state_dict")
+        if self._resume_state is not None:
+            return self._resume_state
+        return self.source.state_dict()  # nothing in flight yet
+
+    def load_state_dict(self, state: Dict) -> None:
+        if self._worker is not None:
+            raise RuntimeError("cannot load_state_dict while an epoch is being prefetched")
+        self._resume_state = None
+        self.source.load_state_dict(state)
+
+    # --------------------------------------------------------------- iteration
+
+    def __iter__(self):
+        self.shutdown()  # at most one in-flight epoch worker
+        if self._stateful:
+            # epoch-start snapshot: a checkpoint taken before the first yield
+            # must resume at this exact position
+            self._resume_state = self.source.state_dict()
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in self.source:
+                    placed = self._put(batch)
+                    snap = self.source.state_dict() if self._stateful else None
+                    if not offer((placed, snap)):
+                        return
+                offer(_DONE)
+            except BaseException as e:  # noqa: BLE001 — must reach the consumer
+                offer(_Failure(e))
+
+        t = threading.Thread(target=worker, name=f"perceiver-prefetch-{id(self):x}", daemon=False)
+        self._worker, self._stop, self._queue = t, stop, q
+        t.start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    if not t.is_alive():
+                        # worker died without a sentinel (should be impossible:
+                        # it wraps everything) — drain once, then fail loudly
+                        try:
+                            item = q.get_nowait()
+                        except queue.Empty:
+                            raise RuntimeError("prefetch worker exited without a result") from None
+                    else:
+                        continue
+                if item is _DONE:
+                    break
+                if isinstance(item, _Failure):
+                    raise item.exc
+                batch, snap = item
+                if snap is not None:
+                    self._resume_state = snap
+                yield batch
+        finally:
+            # runs on exhaustion, break, and consumer exceptions alike
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        """Stop and join the in-flight epoch worker (idempotent). The resume
+        snapshot of the last yielded batch is retained for ``state_dict``."""
+        t, stop, q = self._worker, self._stop, self._queue
+        if t is None:
+            return
+        stop.set()
+        # unblock a worker stuck in put() promptly (its offer() also polls)
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join()
+        self._worker = self._stop = self._queue = None

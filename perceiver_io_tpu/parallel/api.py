@@ -10,7 +10,7 @@ train step with explicit shardings. XLA SPMD inserts all collectives:
 
 from __future__ import annotations
 
-from typing import Callable, Literal
+from typing import Callable, Literal, Optional
 
 import jax
 from jax.sharding import Mesh
@@ -78,6 +78,18 @@ def create_sharded_train_state(
         lambda: TrainState.create(init_fn(), tx, rng=rng), mesh, mode=mode, min_fsdp_size=min_fsdp_size,
         pipeline_axis=pipeline_axis,
     )
+
+
+def make_batch_put(mesh: Optional[Mesh]) -> Callable:
+    """The canonical host-batch -> device placement for the training hot loop:
+    sharded over the mesh's data axes when a mesh is given, plain
+    ``jax.device_put`` (local default device) otherwise. Shared by the fit
+    loop's synchronous path and by ``DevicePrefetcher`` so the prefetched and
+    unprefetched batches land with identical placement."""
+    if mesh is None:
+        return jax.device_put
+    sharding = batch_sharding(mesh)
+    return lambda batch: jax.device_put(batch, sharding)
 
 
 def _with_mesh_context(fn: Callable, mesh: Mesh) -> Callable:

@@ -87,8 +87,12 @@ def main(argv=None):
     state = TrainState.create(params, tx)
 
     def eval_step(params, batch):
+        from perceiver_io_tpu.training.losses import valid_count
+
         logits = eval_model.apply(params, batch["input_ids"], pad_mask=batch.get("pad_mask"))
-        return {"loss": cross_entropy(logits, batch["labels"])}
+        # count = non-ignored (masked) positions: weights the batch mean in
+        # Trainer.evaluate so a short final batch doesn't bias val_loss
+        return {"loss": cross_entropy(logits, batch["labels"]), "count": valid_count(batch["labels"])}
 
     def on_eval(state, metrics):
         # qualitative filled-mask samples each eval (reference text/mlm/lightning.py:77-94)

@@ -8,15 +8,30 @@ their devices (no host round-trip), which Lightning/FSDP could not do.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
     return ocp.StandardCheckpointer()
+
+
+def atomic_write_json(path: str, payload: Any, indent: Optional[int] = None) -> None:
+    """Write JSON via tmp + rename so a kill mid-write can never leave a
+    corrupt file — the one audited code path for every sidecar artifact
+    (iterator snapshots, best-metric records, bench outputs)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=indent)
+        if indent is not None:
+            f.write("\n")
+    os.replace(tmp, path)
 
 
 def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
@@ -44,6 +59,122 @@ def restore_checkpoint(path: str, template: Any, shardings: Optional[Any] = None
     else:
         targets = jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
     return _checkpointer().restore(path, targets)
+
+
+def host_snapshot(state: Any) -> Any:
+    """Device -> host copy of a pytree with every leaf's D2H transfer in flight
+    before the first blocking materialization: ``copy_to_host_async`` dispatches
+    all copies, then ``np.asarray`` waits once per leaf on already-running
+    transfers. The cost on the calling thread is a single device sync (the step
+    that produced ``state`` must finish — unavoidable for a consistent
+    snapshot), NOT the serialization that follows. The returned numpy tree is
+    independent of the device buffers, so later steps may freely donate them
+    (``np.array`` COPIES; ``np.asarray`` is zero-copy on the CPU backend, and a
+    donated buffer would then mutate in place under the pending write)."""
+    for leaf in jax.tree.leaves(state):
+        if isinstance(leaf, jax.Array):
+            leaf.copy_to_host_async()
+    return jax.tree.map(
+        lambda x: np.array(x) if isinstance(x, jax.Array) else x, state
+    )
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint serializer for the periodic in-loop saves.
+
+    Contract (training/fit.py relies on each point):
+      * ``submit`` costs one host snapshot (see ``host_snapshot``) and never
+        waits on serialization — the step loop is not stalled by checkpoint IO;
+      * at most ONE write is outstanding; a ``submit`` while the writer is busy
+        replaces any queued-but-unstarted snapshot (newest wins) — dropping an
+        intermediate periodic ``last`` is semantically free, it would have been
+        overwritten by the next one anyway;
+      * atomicity is unchanged from the sync path: orbax finalizes into the
+        destination via tmp + rename, and aux JSON files (the iterator
+        snapshot) are written tmp + ``os.replace`` AFTER the state commit, the
+        same order the sync path uses;
+      * writer-thread failures are re-raised on the training thread at the next
+        ``submit``/``wait``/``close`` — never swallowed;
+      * ``close`` drains the outstanding write and joins the (non-daemon)
+        thread; the final/best checkpoints stay synchronous and must only be
+        written after ``close``/``wait``.
+
+    Single-process only: the snapshot gathers addressable shards via numpy.
+    Multi-host runs should keep the synchronous path
+    (``PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT=1``).
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending: Optional[tuple] = None
+        self._busy = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            error, self._error = self._error, None
+        if error is not None:
+            raise RuntimeError("async checkpoint write failed") from error
+
+    def submit(self, path: str, state: Any, aux_files: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot ``state`` to host and queue it for serialization to
+        ``path``. ``aux_files`` maps absolute paths to JSON-serializable
+        payloads written (tmp+rename) after the state commit."""
+        self._raise_pending_error()
+        snapshot = host_snapshot(state)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            self._pending = (path, snapshot, dict(aux_files or {}))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="perceiver-async-ckpt", daemon=False
+                )
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:  # closed and drained
+                    return
+                path, snapshot, aux = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                save_checkpoint(path, snapshot)
+                for aux_path, payload in aux.items():
+                    atomic_write_json(aux_path, payload)
+            except BaseException as e:  # noqa: BLE001 — surfaced on the training thread
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def wait(self) -> None:
+        """Block until no write is pending or in progress; re-raise failures."""
+        with self._cond:
+            while self._busy or self._pending is not None:
+                self._cond.wait()
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        """Drain the outstanding write (if any), join the thread, re-raise
+        failures. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join()
+        self._thread = None
+        self._raise_pending_error()
 
 
 class CheckpointManager:

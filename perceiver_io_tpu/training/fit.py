@@ -8,6 +8,27 @@ qualitative sample callbacks (the reference logs filled masks / generated text
 each validation epoch, text/mlm/lightning.py:77-94, text/clm/lightning.py:54-92),
 and tokens/sec + MFU telemetry the reference never had (SURVEY.md §5).
 
+The hot loop is OVERLAPPED (docs/training-pipeline.md): the only host syncs are
+the ones the user asked for (log and eval boundaries).
+
+  * input: batches are collated and ``device_put`` on a background thread
+    (data/prefetch.py, ``TrainerConfig.prefetch_depth`` deep) while the current
+    step runs, preserving the exact mid-epoch resume contract;
+  * telemetry: per-step metrics are folded into device-side window sums by a
+    small jitted add, so ``log_every`` costs ONE transfer of the window totals
+    (the logged loss is the window MEAN) instead of pinning step N's loss every
+    window; ``evaluate`` likewise keeps weighted totals on device and syncs
+    once at the end;
+  * checkpoint IO: periodic ``checkpoint_every`` saves snapshot to host (one
+    device sync, no serialization) and hand the write to a single background
+    writer (training/checkpoint.py AsyncCheckpointWriter); final/best
+    checkpoints stay synchronous.
+
+Kill-switches restore the fully synchronous pre-overlap paths:
+``PERCEIVER_IO_TPU_DISABLE_PREFETCH`` and
+``PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT`` (env), or
+``prefetch_depth=0`` / ``async_checkpoint=False`` in TrainerConfig.
+
 Mesh-parallel: pass ``mesh_axes`` to shard the train state (DP/FSDP/TP per
 parallel/sharding.py) — XLA SPMD handles the collectives.
 """
@@ -16,22 +37,37 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, Optional
 
 import jax
-import numpy as np
+import jax.numpy as jnp
 
+from perceiver_io_tpu.data.prefetch import DevicePrefetcher
 from perceiver_io_tpu.parallel.api import (
     create_sharded_state,
+    make_batch_put,
     make_sharded_eval_step,
     make_sharded_train_step,
     shard_train_state,
 )
-from perceiver_io_tpu.parallel.mesh import batch_sharding, make_mesh
-from perceiver_io_tpu.training.checkpoint import restore_checkpoint, save_checkpoint
+from perceiver_io_tpu.parallel.mesh import make_mesh
+from perceiver_io_tpu.training.checkpoint import (
+    AsyncCheckpointWriter,
+    atomic_write_json,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from perceiver_io_tpu.training.trainer import TrainState
+
+DISABLE_PREFETCH_ENV = "PERCEIVER_IO_TPU_DISABLE_PREFETCH"
+DISABLE_ASYNC_CHECKPOINT_ENV = "PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT"
+
+
+def _env_disabled(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0", "false", "False")
 
 
 @dataclass
@@ -53,6 +89,14 @@ class TrainerConfig:
     tokens_per_batch: Optional[int] = None  # enables tokens/sec telemetry
     flops_per_step: Optional[float] = None  # enables MFU telemetry (see training.flops)
     peak_flops: Optional[float] = None
+    # overlapped hot loop (docs/training-pipeline.md): background batches
+    # in-flight ahead of the step loop; 0 = synchronous input path. The env
+    # kill-switch PERCEIVER_IO_TPU_DISABLE_PREFETCH overrides at fit() time.
+    prefetch_depth: int = 2
+    # periodic checkpoints on a background writer thread; False (or the
+    # PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT env) = serialize inline.
+    # Multi-host runs must use the synchronous path (see AsyncCheckpointWriter).
+    async_checkpoint: bool = True
     # device-trace capture (SURVEY.md §5 tracing: the reference had none; here
     # it is one config knob): a jax.profiler trace of steps
     # [profile_start_step, profile_start_step + profile_steps) is written to
@@ -63,11 +107,24 @@ class TrainerConfig:
     profile_steps: int = 5
 
 
+def _batch_leading_dim(batch) -> int:
+    """Batch-size fallback weight for eval folds when the eval step reports no
+    ``count`` metric — readable from shapes, no device sync."""
+    for leaf in jax.tree.leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 1
+
+
 class Trainer:
     def __init__(self, config: TrainerConfig, log_fn: Callable[[str], None] = print):
         self.config = config
         self.log = log_fn
         self.history: list = []
+        self._metric_fold = None
+        self._eval_init = None
+        self._eval_fold = None
 
     def fit(
         self,
@@ -87,6 +144,7 @@ class Trainer:
         at model-size host/device memory and is fine only below that scale."""
         cfg = self.config
 
+        mesh = None
         if cfg.mesh_axes:
             mesh = make_mesh(cfg.mesh_axes)
             if callable(state):
@@ -99,7 +157,7 @@ class Trainer:
                 )
             step_fn = make_sharded_train_step(train_step, mesh, state_sh)
             eval_fn = make_sharded_eval_step(eval_step, mesh, state_sh.params) if eval_step else None
-            put = lambda b: jax.device_put(b, batch_sharding(mesh))
+            put = make_batch_put(mesh)
         else:
             if callable(state):
                 state = jax.jit(state)()
@@ -107,69 +165,130 @@ class Trainer:
             eval_fn = jax.jit(eval_step) if eval_step else None
             put = lambda b: b
 
+        prefetch_on = cfg.prefetch_depth > 0 and not _env_disabled(DISABLE_PREFETCH_ENV)
+        async_ckpt_on = (
+            cfg.async_checkpoint
+            and cfg.checkpoint_dir
+            and cfg.checkpoint_every
+            and not _env_disabled(DISABLE_ASYNC_CHECKPOINT_ENV)
+        )
+        # the prefetcher performs the device placement on its worker thread;
+        # the step loop then consumes already-on-device batches
+        wrap = (
+            (lambda src: DevicePrefetcher(src, depth=cfg.prefetch_depth, put=make_batch_put(mesh)))
+            if prefetch_on
+            else (lambda src: src)
+        )
+        loop_put = (lambda b: b) if prefetch_on else put
+        writer = AsyncCheckpointWriter() if async_ckpt_on else None
+
         # ``initial_best`` carries the monitor value of an earlier run's best
         # checkpoint across a resume — without it the first post-resume eval
         # would overwrite <checkpoint_dir>/best even when it is worse.
         best = initial_best
         step_count = int(state.step)
         window_t0, window_steps = time.perf_counter(), 0
+        # device-side metric accumulation: the window's sums live on device and
+        # are transferred ONCE per log boundary (acc_steps is the divisor; it is
+        # separate from window_steps, which eval/checkpoint boundaries reset to
+        # keep throughput telemetry honest)
+        acc, acc_steps = None, 0
         # A stateful (resumable) loader is obtained ONCE and re-iterated per
         # epoch, so restored mid-epoch positions survive and its state can be
         # checkpointed; stateless sources keep the build-per-epoch contract.
-        first_source = train_loader_fn()
-        stateful = hasattr(first_source, "state_dict")
+        raw_first = train_loader_fn()
+        stateful = hasattr(raw_first, "state_dict")
+        first_source = wrap(raw_first)
         self._train_source = first_source if stateful else None
 
         profiling = False
-        while step_count < cfg.max_steps:
-            epoch_source = first_source if stateful else train_loader_fn()
-            self._train_source = epoch_source if stateful else None
-            for batch in epoch_source:
-                if cfg.profile_dir and step_count == cfg.profile_start_step and not profiling:
-                    jax.block_until_ready(state.params)  # trace device work of OUR steps only
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
-                state, metrics = step_fn(state, put(batch))
-                step_count += 1
-                window_steps += 1
+        epoch_source = None
+        try:
+            while step_count < cfg.max_steps:
+                epoch_source = first_source if stateful else wrap(train_loader_fn())
+                self._train_source = epoch_source if stateful else None
+                for batch in epoch_source:
+                    if cfg.profile_dir and step_count == cfg.profile_start_step and not profiling:
+                        jax.block_until_ready(state.params)  # trace device work of OUR steps only
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = True
+                    state, metrics = step_fn(state, loop_put(batch))
+                    step_count += 1
+                    window_steps += 1
+                    acc = metrics if acc is None else self._fold_metrics(acc, metrics)
+                    acc_steps += 1
 
-                if profiling and step_count >= cfg.profile_start_step + cfg.profile_steps:
-                    jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    self.log(json.dumps({"step": step_count, "profile_trace": cfg.profile_dir}))
-                    window_t0, window_steps = time.perf_counter(), 0  # exclude trace IO
+                    if profiling and step_count >= cfg.profile_start_step + cfg.profile_steps:
+                        jax.block_until_ready(acc["loss"])
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        self.log(json.dumps({"step": step_count, "profile_trace": cfg.profile_dir}))
+                        window_t0, window_steps = time.perf_counter(), 0  # exclude trace IO
 
-                if step_count % cfg.log_every == 0:
-                    loss = float(metrics["loss"])
-                    dt = time.perf_counter() - window_t0
-                    line = {"step": step_count, "loss": round(loss, 5)}
-                    if cfg.tokens_per_batch:
-                        tps = cfg.tokens_per_batch * window_steps / dt
-                        line["tokens_per_sec"] = round(tps, 1)
-                        if cfg.flops_per_step and cfg.peak_flops:
-                            line["mfu"] = round(cfg.flops_per_step * window_steps / dt / cfg.peak_flops, 4)
-                    self.history.append(line)
-                    self.log(json.dumps(line))
-                    window_t0, window_steps = time.perf_counter(), 0
+                    if step_count % cfg.log_every == 0:
+                        sums = jax.device_get(acc)  # the window's ONE host sync
+                        means = {k: float(v) / acc_steps for k, v in sums.items()}
+                        acc, acc_steps = None, 0
+                        dt = time.perf_counter() - window_t0
+                        line = {"step": step_count, **{k: round(v, 5) for k, v in means.items()}}
+                        if cfg.tokens_per_batch:
+                            tps = cfg.tokens_per_batch * window_steps / dt
+                            line["tokens_per_sec"] = round(tps, 1)
+                            if cfg.flops_per_step and cfg.peak_flops:
+                                line["mfu"] = round(cfg.flops_per_step * window_steps / dt / cfg.peak_flops, 4)
+                        self.history.append(line)
+                        self.log(json.dumps(line))
+                        window_t0, window_steps = time.perf_counter(), 0
 
-                if cfg.checkpoint_dir and cfg.checkpoint_every and step_count % cfg.checkpoint_every == 0:
-                    save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
-                    self._save_iterator_state("last_iterator.json")
+                    if cfg.checkpoint_dir and cfg.checkpoint_every and step_count % cfg.checkpoint_every == 0:
+                        if writer is not None:
+                            # host snapshot only — serialization happens on the
+                            # writer thread, the step loop continues immediately
+                            writer.submit(
+                                os.path.join(cfg.checkpoint_dir, "last"),
+                                state,
+                                aux_files=self._iterator_aux("last_iterator.json"),
+                            )
+                        else:
+                            save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
+                            self._save_iterator_state("last_iterator.json")
+                        # checkpoint wall time must not pollute the next
+                        # tokens/sec + MFU sample: the sync branch serializes
+                        # inline, and even the async submit pays a device sync
+                        # + full-state D2H copy (seconds at large model scale)
+                        window_t0, window_steps = time.perf_counter(), 0
 
-                if eval_fn is not None and step_count % cfg.eval_every == 0:
-                    val = self.evaluate(state, eval_fn, eval_loader_fn(), put)
-                    line = {"step": step_count, **{f"val_{k}": round(float(v), 5) for k, v in val.items()}}
-                    self.history.append(line)
-                    self.log(json.dumps(line))
-                    if on_eval is not None:
-                        on_eval(state, val)
-                    best = self._maybe_checkpoint(state, val, best)
-                    # eval/checkpoint wall time must not pollute throughput telemetry
-                    window_t0, window_steps = time.perf_counter(), 0
+                    if eval_fn is not None and step_count % cfg.eval_every == 0:
+                        val = self.evaluate(state, eval_fn, eval_loader_fn(), put)
+                        line = {"step": step_count, **{f"val_{k}": round(float(v), 5) for k, v in val.items()}}
+                        self.history.append(line)
+                        self.log(json.dumps(line))
+                        if on_eval is not None:
+                            on_eval(state, val)
+                        best = self._maybe_checkpoint(state, val, best, writer)
+                        # eval/checkpoint wall time must not pollute throughput telemetry
+                        window_t0, window_steps = time.perf_counter(), 0
 
-                if step_count >= cfg.max_steps:
-                    break
+                    if step_count >= cfg.max_steps:
+                        break
+        finally:
+            # threads must ALWAYS join — normal completion, max_steps break,
+            # and exceptions anywhere in the loop alike
+            for src in (epoch_source, first_source):
+                if isinstance(src, DevicePrefetcher):
+                    src.shutdown()
+            if writer is not None:
+                # captured BEFORE close(): inside an except handler the
+                # just-caught exception is what exc_info reports, which would
+                # make a suppression guard there unconditionally true
+                fit_unwinding = sys.exc_info()[0] is not None
+                try:
+                    # drains the outstanding write; the final synchronous save
+                    # below must not race a background write to the same path
+                    writer.close()
+                except Exception:
+                    if not fit_unwinding:
+                        raise  # surface writer failures when fit itself succeeded
 
         if profiling:  # max_steps inside the profile window
             jax.profiler.stop_trace()
@@ -178,19 +297,36 @@ class Trainer:
             self._save_iterator_state("last_iterator.json")
         return state
 
+    def _fold_metrics(self, acc, metrics):
+        """Jitted device-side add of a step's metrics into the window sums —
+        no host transfer; the accumulator buffers are donated in place."""
+        if self._metric_fold is None:
+            self._metric_fold = jax.jit(
+                lambda a, m: jax.tree.map(jnp.add, a, m), donate_argnums=(0,)
+            )
+        return self._metric_fold(acc, metrics)
+
+    def _iterator_aux(self, filename: str) -> Optional[Dict]:
+        """Iterator snapshot paired with an async state write: captured NOW
+        (synchronously, so it matches the state snapshot), serialized later by
+        the writer thread."""
+        src = getattr(self, "_train_source", None)
+        if not self.config.checkpoint_dir or src is None or not hasattr(src, "state_dict"):
+            return None
+        return {os.path.join(self.config.checkpoint_dir, filename): src.state_dict()}
+
     def _save_iterator_state(self, filename: str) -> None:
         """Persist the train loader's exact position (epoch RNG + consumed
         batches) next to the checkpoint, when the loader supports it — enables
-        resume on precisely the next unseen batch (data/loader.py), a recovery
-        guarantee the reference's Lightning restarts do not make."""
+        resume on precisely the next unseen batch (data/loader.py; under
+        prefetch the position is the last batch the STEP LOOP consumed, not the
+        worker's read-ahead — data/prefetch.py), a recovery guarantee the
+        reference's Lightning restarts do not make."""
         src = getattr(self, "_train_source", None)
         if not self.config.checkpoint_dir or src is None or not hasattr(src, "state_dict"):
             return
-        path = os.path.join(self.config.checkpoint_dir, filename)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(src.state_dict(), f)
-        os.replace(tmp, path)  # atomic: a preemption mid-write cannot corrupt the snapshot
+        # atomic: a preemption mid-write cannot corrupt the snapshot
+        atomic_write_json(os.path.join(self.config.checkpoint_dir, filename), src.state_dict())
 
     @staticmethod
     def restore_iterator(path: str, loader) -> None:
@@ -200,32 +336,64 @@ class Trainer:
             loader.load_state_dict(json.load(f))
 
     def evaluate(self, state: TrainState, eval_fn, loader, put) -> Dict[str, float]:
-        totals: Dict[str, float] = {}
-        n = 0
+        """Weighted eval with device-side accumulation: each batch's metric
+        means are folded into running totals ON DEVICE, weighted by the batch's
+        real contribution — the eval step's ``count`` metric (non-ignored
+        example/token count) when present, the batch leading dim otherwise —
+        and the host syncs ONCE at the end. Equal-weight averaging of per-batch
+        means would bias the result whenever the last batch is short."""
+        totals, weight_sum = None, None
         for batch in loader:
-            metrics = eval_fn(state.params, put(batch))
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            n += 1
-        return {k: v / max(n, 1) for k, v in totals.items()}
+            fallback_w = float(_batch_leading_dim(batch))
+            m = dict(eval_fn(state.params, put(batch)))
+            w = m.pop("count", fallback_w)
+            if totals is None:
+                if self._eval_init is None:
+                    self._eval_init = jax.jit(
+                        lambda m, w: (
+                            jax.tree.map(lambda x: x * jnp.float32(w), m),
+                            jnp.float32(w),
+                        )
+                    )
+                totals, weight_sum = self._eval_init(m, w)
+            else:
+                if self._eval_fold is None:
+                    self._eval_fold = jax.jit(
+                        lambda tot, ws, m, w: (
+                            jax.tree.map(lambda t, x: t + x * jnp.float32(w), tot, m),
+                            ws + jnp.float32(w),
+                        ),
+                        donate_argnums=(0, 1),
+                    )
+                totals, weight_sum = self._eval_fold(totals, weight_sum, m, w)
+        if totals is None:
+            return {}
+        sums, wsum = jax.device_get((totals, weight_sum))  # the eval's one sync
+        denom = max(float(wsum), 1e-9)
+        return {k: float(v) / denom for k, v in sums.items()}
 
-    def _maybe_checkpoint(self, state: TrainState, val: Dict[str, float], best):
+    def _maybe_checkpoint(self, state: TrainState, val: Dict[str, float], best,
+                          writer: Optional[AsyncCheckpointWriter] = None):
         cfg = self.config
         if not cfg.checkpoint_dir or cfg.monitor not in val:
             return best
         value = val[cfg.monitor]
         better = best is None or (value < best if cfg.monitor_mode == "min" else value > best)
         if better:
+            if writer is not None:
+                # 'best' stays synchronous (durability over overlap), but an
+                # in-flight periodic write must finish first: orbax checkpoint
+                # dirs must not be written concurrently from two threads
+                writer.wait()
             save_checkpoint(os.path.join(cfg.checkpoint_dir, "best"), state)
             # keep the iterator snapshot in lockstep with the weights it pairs with
             self._save_iterator_state("best_iterator.json")
             # persist the monitor value so a resumed run keeps competing
             # against this best instead of overwriting it unconditionally
-            path = os.path.join(cfg.checkpoint_dir, "best_metric.json")
-            tmp = f"{path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump({"monitor": cfg.monitor, "value": float(value)}, f)
-            os.replace(tmp, path)
+            atomic_write_json(
+                os.path.join(cfg.checkpoint_dir, "best_metric.json"),
+                {"monitor": cfg.monitor, "value": float(value)},
+            )
             self.log(json.dumps({"checkpoint": "best", cfg.monitor: round(value, 5)}))
             return value
         return best

@@ -25,6 +25,14 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return losses.sum() / jnp.maximum(valid.sum(), 1)
 
 
+def valid_count(labels: jax.Array) -> jax.Array:
+    """Number of positions that contribute to the CE/accuracy mean (label !=
+    IGNORE_INDEX). Eval steps report it as the reserved ``count`` metric so
+    Trainer.evaluate can weight per-batch means by real example/token count —
+    equal-weight averaging biases val_loss whenever the last batch is short."""
+    return (labels != IGNORE_INDEX).sum()
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     valid = labels != IGNORE_INDEX
     correct = (logits.argmax(-1) == labels) & valid

@@ -18,7 +18,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from perceiver_io_tpu.training.losses import IGNORE_INDEX, classification_loss_and_metrics, cross_entropy
+from perceiver_io_tpu.training.losses import (
+    IGNORE_INDEX,
+    classification_loss_and_metrics,
+    cross_entropy,
+    valid_count,
+)
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -97,7 +102,8 @@ def make_classifier_eval_step(model, input_key: str = "image", label_key: str = 
     def eval_step(params, batch):
         logits = model.apply(params, batch[input_key], pad_mask=batch.get("pad_mask"))
         _, metrics = classification_loss_and_metrics(logits, batch[label_key])
-        return metrics
+        # reserved key: Trainer.evaluate weights this batch's means by it
+        return {**metrics, "count": valid_count(batch[label_key])}
 
     return eval_step
 
@@ -162,6 +168,8 @@ def make_causal_lm_eval_step(model, max_latents: int):
             labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
         labels = labels[:, prefix_len:]
         logits = model.apply(params, x, prefix_len=prefix_len, pad_mask=pad_mask)
-        return {"loss": cross_entropy(logits, labels)}
+        # ``count`` = real (non-ignored) token count: Trainer.evaluate weights
+        # this batch's mean by it so short final batches don't bias val_loss
+        return {"loss": cross_entropy(logits, labels), "count": valid_count(labels)}
 
     return eval_step

@@ -1,0 +1,263 @@
+"""Training-pipeline benchmark: overlapped hot loop vs fully synchronous loop.
+
+Drives the REAL ``Trainer.fit`` path (training/fit.py) over a synthetic
+INPUT-BOUND workload — a loader whose per-batch host cost (collate numpy work +
+simulated IO wait) is calibrated to the measured device step time, the regime
+the overlap exists for. Two arms:
+
+  * ``overlapped``  — the default loop: background device prefetch
+    (``prefetch_depth``), device-side metric accumulation, async checkpointing;
+  * ``synchronous`` — ``prefetch_depth=0`` + ``async_checkpoint=False``, i.e.
+    the pre-overlap loop (same code the env kill-switches
+    PERCEIVER_IO_TPU_DISABLE_PREFETCH / _DISABLE_ASYNC_CHECKPOINT force).
+
+Steady-state throughput comes from the trainer's own window telemetry
+(``tokens_per_batch=1`` makes tokens/sec read as steps/sec), taken from the
+windows AFTER the first (which absorbs compile). ``--profile`` runs the A/B
+INTERLEAVED best-of-5 (the same methodology as BENCH_serving.json: alternating
+arms cancel allocator/cache warm-up drift; best-of cancels shared-CPU noise)
+and writes the per-PR artifact ``BENCH_train_pipeline.json``, including the
+host-input vs device-compute split that explains the speedup:
+sync steady step ≈ host + device, overlapped ≈ max(host, device).
+
+Runs anywhere: ``JAX_PLATFORMS=cpu python scripts/train_bench.py`` finishes in
+seconds (smoke-driven by tests/test_prefetch.py);
+``--profile`` takes a few minutes on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_model(preset: str):
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+    if preset == "tiny":
+        config = CausalSequenceModelConfig(
+            vocab_size=262, max_seq_len=64, max_latents=16, num_channels=32,
+            num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.0,
+        )
+    elif preset == "profile":
+        # big enough that a CPU device step is a few ms (a real overlap
+        # window), small enough that best-of-5 x 2 arms stays CPU-friendly
+        config = CausalSequenceModelConfig(
+            vocab_size=262, max_seq_len=256, max_latents=64, num_channels=128,
+            num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.0,
+        )
+    else:
+        raise SystemExit(f"unknown preset {preset!r} (tiny | profile)")
+    return CausalSequenceModel(config=config, deterministic=True), config
+
+
+class InputBoundLoader:
+    """Synthetic input-bound source: each batch costs ``host_seconds`` of host
+    time (numpy token generation + a sleep standing in for disk/network IO —
+    both release the GIL, exactly like a real input pipeline) before it is
+    ready. Tracks its own host wall time so the bench can report the
+    host-input vs device-compute split honestly."""
+
+    def __init__(self, config, batch_size: int, num_batches: int, host_seconds: float, seed: int = 0):
+        self.config = config
+        self.batch_size = batch_size
+        self.num_batches = num_batches
+        self.host_seconds = host_seconds
+        self.seed = seed
+        self.host_time_total = 0.0
+        self.batches_produced = 0
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed)
+        for _ in range(self.num_batches):
+            t0 = time.perf_counter()
+            ids = rng.randint(1, self.config.vocab_size,
+                              size=(self.batch_size, self.config.max_seq_len)).astype(np.int32)
+            batch = {"input_ids": ids, "labels": np.roll(ids, -1, axis=1)}
+            elapsed = time.perf_counter() - t0
+            if elapsed < self.host_seconds:
+                time.sleep(self.host_seconds - elapsed)
+            self.host_time_total += time.perf_counter() - t0
+            self.batches_produced += 1
+            yield batch
+
+
+def calibrate_device_step(model, config, host_params, tx, batch_size: int, probes: int = 20) -> float:
+    """Median wall time of one fully-synced train step (the device-compute side
+    of the split). Fresh state: the jitted step donates its buffers."""
+    from perceiver_io_tpu.training.trainer import TrainState, make_causal_lm_train_step
+
+    state = TrainState.create(jax.tree.map(jnp.asarray, host_params), tx)
+    step = jax.jit(make_causal_lm_train_step(model, tx, max_latents=config.max_latents),
+                   donate_argnums=(0,))
+    rng = np.random.RandomState(123)
+    ids = rng.randint(1, config.vocab_size, size=(batch_size, config.max_seq_len)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(np.roll(ids, -1, axis=1))}
+    state, m = step(state, batch)  # compile
+    jax.block_until_ready(m["loss"])
+    times = []
+    for _ in range(probes):
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        jax.block_until_ready(m["loss"])
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def run_arm(model, config, host_params, tx, *, overlapped: bool, steps: int, window: int,
+            batch_size: int, host_seconds: float, prefetch_depth: int, seed: int) -> dict:
+    """One fit through the production Trainer; steady-state steps/sec is the
+    best post-compile window's tokens_per_sec (tokens_per_batch=1)."""
+    from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
+    from perceiver_io_tpu.training.trainer import TrainState, make_causal_lm_train_step
+
+    loader = InputBoundLoader(config, batch_size, num_batches=steps + 8,
+                              host_seconds=host_seconds, seed=seed)
+    cfg = TrainerConfig(
+        max_steps=steps, log_every=window, eval_every=10 ** 9,
+        tokens_per_batch=1,  # tokens/sec telemetry == steps/sec
+        prefetch_depth=prefetch_depth if overlapped else 0,
+        async_checkpoint=overlapped,
+    )
+    lines = []
+    trainer = Trainer(cfg, log_fn=lambda line: lines.append(json.loads(line)))
+    state = TrainState.create(jax.tree.map(jnp.asarray, host_params), tx)
+    trainer.fit(state, make_causal_lm_train_step(model, tx, max_latents=config.max_latents),
+                lambda: loader)
+    windows = [l["tokens_per_sec"] for l in lines if "tokens_per_sec" in l]
+    if len(windows) < 2:
+        raise SystemExit(f"need >= 2 telemetry windows, got {windows} (raise --steps)")
+    steady = max(windows[1:])  # window 1 absorbs compile
+    return {
+        "steps_per_s": steady,
+        "windows_steps_per_s": windows,
+        "host_s_per_batch_measured": round(loader.host_time_total / max(loader.batches_produced, 1), 5),
+    }
+
+
+def run_profile(model, config, host_params, tx, args) -> dict:
+    device_s = calibrate_device_step(model, config, host_params, tx, args.batch_size)
+    host_s = args.host_ms / 1000.0 if args.host_ms is not None else device_s
+    common = dict(steps=args.steps, window=args.window, batch_size=args.batch_size,
+                  host_seconds=host_s, prefetch_depth=args.prefetch_depth, seed=args.seed)
+    # INTERLEAVED A/B/A/B ... best-of-N: alternating arms cancels the
+    # systematic first-arm warm-up penalty; best-of cancels shared-CPU noise
+    # (the BENCH_serving.json methodology)
+    overlapped_runs, synchronous_runs = [], []
+    for rep in range(args.repeats):
+        overlapped_runs.append(run_arm(model, config, host_params, tx, overlapped=True, **common))
+        synchronous_runs.append(run_arm(model, config, host_params, tx, overlapped=False, **common))
+        print(json.dumps({"repeat": rep,
+                          "overlapped_steps_per_s": overlapped_runs[-1]["steps_per_s"],
+                          "synchronous_steps_per_s": synchronous_runs[-1]["steps_per_s"]}),
+              file=sys.stderr)
+    best_overlap = max(r["steps_per_s"] for r in overlapped_runs)
+    best_sync = max(r["steps_per_s"] for r in synchronous_runs)
+    return {
+        "model": {
+            "window": config.max_seq_len, "max_latents": config.max_latents,
+            "num_channels": config.num_channels,
+            "num_self_attention_layers": config.num_self_attention_layers,
+            "batch_size": args.batch_size,
+        },
+        "workload": {
+            "kind": "synthetic input-bound (host collate + simulated IO per batch)",
+            "host_s_per_batch": round(host_s, 5),
+            "device_s_per_step": round(device_s, 5),
+            "host_calibrated_to_device": args.host_ms is None,
+            "steps_per_run": args.steps, "telemetry_window": args.window,
+            "prefetch_depth": args.prefetch_depth, "repeats": args.repeats,
+            "interleaved": True,
+        },
+        "overlapped": {
+            "steps_per_s": best_overlap,
+            "runs_steps_per_s": [r["steps_per_s"] for r in overlapped_runs],
+        },
+        "synchronous": {
+            "steps_per_s": best_sync,
+            "runs_steps_per_s": [r["steps_per_s"] for r in synchronous_runs],
+        },
+        "overlap_speedup": round(best_overlap / best_sync, 3) if best_sync > 0 else 0.0,
+        "expected_bound": {
+            "synchronous_steps_per_s": round(1.0 / (host_s + device_s), 2),
+            "overlapped_steps_per_s": round(1.0 / max(host_s, device_s), 2),
+        },
+    }
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--preset", default="tiny", choices=("tiny", "profile"))
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--window", type=int, default=20, help="telemetry window (log_every)")
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--host-ms", type=float, default=None,
+                    help="host input cost per batch in ms (default: calibrate to the device step)")
+    ap.add_argument("--prefetch-depth", type=int, default=2)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--profile", action="store_true",
+                    help="interleaved best-of-N A/B; writes --profile-out")
+    ap.add_argument("--profile-out", default=os.path.join(_REPO, "BENCH_train_pipeline.json"))
+    ap.add_argument("--out", default=os.path.join(_REPO, "TRAIN_BENCH.json"))
+    args = ap.parse_args(argv)
+
+    model, config = build_model(args.preset)
+    rng = jax.random.PRNGKey(args.seed)
+    init_ids = jnp.zeros((2, config.max_seq_len), jnp.int32)
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        rng, init_ids, prefix_len=config.max_seq_len - config.max_latents
+    )
+    from perceiver_io_tpu.training.trainer import build_optimizer
+
+    tx = build_optimizer(1e-3)
+    # pristine host copy: fit donates state buffers, every run re-materializes
+    host_params = jax.device_get(params)
+
+    if args.profile:
+        result = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            **run_profile(model, config, host_params, tx, args),
+        }
+        out_path = args.profile_out
+    else:
+        device_s = calibrate_device_step(model, config, host_params, tx, args.batch_size, probes=5)
+        host_s = args.host_ms / 1000.0 if args.host_ms is not None else device_s
+        arm = run_arm(model, config, host_params, tx, overlapped=True, steps=args.steps,
+                      window=args.window, batch_size=args.batch_size, host_seconds=host_s,
+                      prefetch_depth=args.prefetch_depth, seed=args.seed)
+        result = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "host_s_per_batch": round(host_s, 5),
+            "device_s_per_step": round(device_s, 5),
+            "overlapped": arm,
+        }
+        out_path = args.out
+
+    from perceiver_io_tpu.training.checkpoint import atomic_write_json
+
+    # atomic: a kill mid-write must not corrupt the artifact
+    atomic_write_json(out_path, result, indent=1)
+    print(json.dumps(result))
+    print(f"wrote {out_path}", file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    main()

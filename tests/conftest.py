@@ -192,6 +192,52 @@ def pytest_collection_modifyitems(config, items):
             )
 
 
+# Thread prefixes that are process-wide caches/pools, not per-test leaks:
+# concurrent.futures keeps idle workers alive after an executor is collected,
+# and orbax/tensorstore park IO threads between checkpoints. OUR threads
+# (perceiver-prefetch-*, perceiver-async-ckpt) are never on this list — they
+# must ALWAYS join, including on exceptions mid-epoch.
+_BENIGN_THREAD_PREFIXES = (
+    "ThreadPoolExecutor",
+    "asyncio_",
+    "pydevd",
+    "grpc",
+    "tensorstore",
+    "ocdbt",
+)
+
+
+@pytest.fixture(autouse=True)
+def assert_no_leaked_threads():
+    """Every test must leave no NEW live non-daemon threads behind: the
+    prefetcher and async-checkpoint writer threads (data/prefetch.py,
+    training/checkpoint.py) must always join — on normal completion, early
+    break, and exceptions mid-epoch alike. A short grace window lets threads
+    that are mid-join at teardown finish."""
+    import time as _time
+
+    before = set(threading.enumerate())
+
+    yield
+
+    def leaked():
+        return [
+            t
+            for t in threading.enumerate()
+            if t not in before
+            and t.is_alive()
+            and not t.daemon
+            and not t.name.startswith(_BENIGN_THREAD_PREFIXES)
+        ]
+
+    deadline = _time.monotonic() + 5.0
+    bad = leaked()
+    while bad and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        bad = leaked()
+    assert not bad, f"leaked non-daemon threads: {[t.name for t in bad]}"
+
+
 @pytest.fixture(scope="module")
 def x64():
     """Enable float64 for strict (bitwise / 1e-12) equivalence tests."""

@@ -1,0 +1,425 @@
+"""Overlapped-training-loop tests: device prefetch (exact-resume contract,
+exception propagation, thread lifecycle), bitwise kill-switch parity, async
+checkpointing (non-blocking steps, coalescing, durability), and weighted eval
+accumulation. See docs/training-pipeline.md for the contracts pinned here."""
+
+import json
+import os
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import perceiver_io_tpu.training.checkpoint as ckpt_mod
+import perceiver_io_tpu.training.fit as fit_mod
+from perceiver_io_tpu.data.loader import DataLoader
+from perceiver_io_tpu.data.prefetch import DevicePrefetcher
+from perceiver_io_tpu.training.checkpoint import AsyncCheckpointWriter
+from perceiver_io_tpu.training.fit import (
+    DISABLE_ASYNC_CHECKPOINT_ENV,
+    DISABLE_PREFETCH_ENV,
+    Trainer,
+    TrainerConfig,
+)
+from perceiver_io_tpu.training.trainer import TrainState, build_optimizer
+
+
+def make_loader(n=24, batch_size=2, seed=0, shuffle=True):
+    """Stateful loader over identifiable examples: each batch carries the raw
+    example ids so tests can compare exact batch sequences."""
+    return DataLoader(
+        list(range(n)),
+        batch_size,
+        collate_fn=lambda ex: {"ids": np.asarray(ex, np.int64)},
+        shuffle=shuffle,
+        rng=np.random.default_rng(seed),
+    )
+
+
+def drain_ids(source, num_batches=None):
+    out = []
+    for i, batch in enumerate(source):
+        out.append(np.asarray(batch["ids"]).tolist())
+        if num_batches is not None and i + 1 == num_batches:
+            break
+    return out
+
+
+# ------------------------------------------------------------ prefetcher core
+
+
+def test_prefetcher_preserves_order_and_places_on_device():
+    loader = make_loader()
+    expected = drain_ids(make_loader())
+    pf = DevicePrefetcher(loader, depth=3)
+    got = []
+    for batch in pf:
+        assert isinstance(batch["ids"], jax.Array)  # placed by the worker
+        got.append(np.asarray(batch["ids"]).tolist())
+    assert got == expected
+
+
+def test_prefetcher_exact_resume_with_batches_in_flight():
+    """Kill mid-epoch while the worker has read ahead: a restore from
+    state_dict() replays precisely the batches the CONSUMER had not yet seen —
+    in-flight batches are neither skipped nor repeated."""
+    uninterrupted = drain_ids(make_loader()) + drain_ids_second_epoch(seed=0)
+
+    loader = make_loader()
+    pf = DevicePrefetcher(loader, depth=4)
+    it = iter(pf)
+    consumed = [np.asarray(next(it)["ids"]).tolist() for _ in range(5)]
+    # wait until the worker has demonstrably read AHEAD of the consumer
+    deadline = time.monotonic() + 5.0
+    while loader._consumed <= 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert loader._consumed > 5, "worker never prefetched ahead; test setup broken"
+    snap = pf.state_dict()
+    assert snap["batches_consumed"] == 5  # rewound to the consumer's position
+    pf.shutdown()
+
+    restored_loader = make_loader()
+    restored_loader.load_state_dict(snap)
+    resumed = drain_ids(DevicePrefetcher(restored_loader, depth=4))
+    resumed += drain_ids(DevicePrefetcher(restored_loader, depth=4))  # next epoch
+    assert consumed + resumed == uninterrupted
+
+
+def drain_ids_second_epoch(seed):
+    loader = make_loader(seed=seed)
+    drain_ids(loader)
+    return drain_ids(loader)
+
+
+def test_prefetcher_propagates_worker_exception_after_good_batches():
+    class Boom(RuntimeError):
+        pass
+
+    def source():
+        for i, batch in enumerate(make_loader()):
+            if i == 3:
+                raise Boom("collate failed")
+            yield batch
+
+    pf = DevicePrefetcher(source(), depth=2)
+    got = []
+    with pytest.raises(Boom, match="collate failed"):
+        for batch in pf:
+            got.append(batch)
+    assert len(got) == 3  # batches fetched before the failure are delivered
+
+
+def test_prefetcher_early_break_joins_worker():
+    pf = DevicePrefetcher(make_loader(n=240, batch_size=2), depth=2)
+    for i, _ in enumerate(pf):
+        if i == 2:
+            break
+    pf.shutdown()
+    assert not any(t.name.startswith("perceiver-prefetch") for t in threading.enumerate())
+
+
+# ------------------------------------------------- fit-level parity and resume
+
+
+def clm_fit_arm(monkeypatch, disable_prefetch: bool, steps=8):
+    """One fit run of a tiny float64 CLM: returns the per-step loss trajectory
+    (log_every=1, so the window mean degenerates to the exact step loss — the
+    pre-overlap loop's logged quantity)."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+    from perceiver_io_tpu.training.trainer import make_causal_lm_train_step
+
+    if disable_prefetch:
+        monkeypatch.setenv(DISABLE_PREFETCH_ENV, "1")
+    else:
+        monkeypatch.delenv(DISABLE_PREFETCH_ENV, raising=False)
+
+    cfg = CausalSequenceModelConfig(
+        vocab_size=50, max_seq_len=16, max_latents=8, num_channels=16, num_heads=2,
+        num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=cfg, deterministic=True, param_dtype=jnp.float64)
+    rs = np.random.RandomState(7)
+    seqs = [rs.randint(1, 50, size=16).astype(np.int32) for _ in range(12)]
+    loader = DataLoader(
+        seqs, 2,
+        collate_fn=lambda ex: {
+            "input_ids": np.stack(ex),
+            "labels": np.roll(np.stack(ex), -1, axis=1),
+        },
+        shuffle=True,
+        rng=np.random.default_rng(3),
+    )
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        jax.random.PRNGKey(0), jnp.asarray(np.stack(seqs[:2])), prefix_len=8
+    )
+    tx = build_optimizer(1e-3)
+    state = TrainState.create(params, tx)
+    losses = []
+    trainer = Trainer(
+        TrainerConfig(max_steps=steps, log_every=1, eval_every=10_000),
+        log_fn=lambda line: losses.append(json.loads(line).get("loss")),
+    )
+    trainer.fit(state, make_causal_lm_train_step(model, tx, max_latents=8), lambda: loader)
+    return losses
+
+
+def test_fit_loss_trajectory_bitwise_parity_prefetch_vs_kill_switch(x64, monkeypatch):
+    """float64-pinned: the overlapped loop must be a pure scheduling change —
+    prefetch-on and PERCEIVER_IO_TPU_DISABLE_PREFETCH=1 produce bit-identical
+    per-step loss trajectories (the kill-switch arm IS the pre-overlap loop:
+    synchronous host collate + put before every dispatch)."""
+    overlapped = clm_fit_arm(monkeypatch, disable_prefetch=False)
+    synchronous = clm_fit_arm(monkeypatch, disable_prefetch=True)
+    assert len(overlapped) == 8
+    assert overlapped == synchronous  # bitwise: float64 values compared exactly
+
+
+def _id_train_setup():
+    """Trainer-level harness where each step's logged metrics carry the batch's
+    first example id — the history IS the consumed-batch sequence."""
+    import optax
+
+    tx = optax.sgd(1e-2)
+    # a factory, not a tree: the fit loop DONATES state buffers, so every run
+    # needs fresh arrays
+    make_params = lambda: {"w": jnp.zeros((4,), jnp.float32)}
+
+    def train_step(state, batch):
+        grads = jax.tree.map(jnp.zeros_like, state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(step=state.step + 1, params=params, opt_state=opt_state),
+            {"loss": jnp.float32(0.0), "first_id": batch["ids"][0].astype(jnp.float32)},
+        )
+
+    return make_params, tx, train_step
+
+
+def test_fit_checkpoint_resume_with_inflight_batches_matches_full_run(tmp_path):
+    """The trainer-level exact-resume pin: kill a prefetching fit mid-epoch
+    (batches in flight), resume from the periodic checkpoint, and the replayed
+    batch sequence must be identical to an uninterrupted run's."""
+    make_params, tx, train_step = _id_train_setup()
+
+    def run(loader, trainer_cfg, state, kill_at=None):
+        ids = []
+
+        class Killed(RuntimeError):
+            pass
+
+        def log_fn(line):
+            rec = json.loads(line)
+            if "first_id" in rec:
+                ids.append(int(rec["first_id"]))
+                if kill_at is not None and rec["step"] >= kill_at:
+                    raise Killed()
+
+        trainer = Trainer(trainer_cfg, log_fn=log_fn)
+        if kill_at is None:
+            trainer.fit(state, train_step, lambda: loader)
+        else:
+            with pytest.raises(Killed):
+                trainer.fit(state, train_step, lambda: loader)
+        return ids
+
+    full_ids = run(
+        make_loader(n=60, batch_size=2, seed=5),
+        TrainerConfig(max_steps=12, log_every=1, eval_every=10_000, prefetch_depth=3),
+        TrainState.create(make_params(), tx),
+    )
+
+    killed_dir = str(tmp_path / "killed")
+    killed_ids = run(
+        make_loader(n=60, batch_size=2, seed=5),
+        TrainerConfig(max_steps=12, log_every=1, eval_every=10_000, prefetch_depth=3,
+                      checkpoint_dir=killed_dir, checkpoint_every=2),
+        TrainState.create(make_params(), tx),
+        kill_at=5,
+    )
+    assert killed_ids == full_ids[:5]
+
+    # the periodic (async) checkpoint at step 4 must have landed, with the
+    # iterator rewound to the CONSUMER's position despite worker read-ahead
+    with open(os.path.join(killed_dir, "last_iterator.json")) as f:
+        it_state = json.load(f)
+    assert it_state["batches_consumed"] == 4
+
+    template = TrainState.create(make_params(), tx)
+    restored = Trainer.restore(os.path.join(killed_dir, "last"), template)
+    assert int(restored.step) == 4
+    resumed_loader = make_loader(n=60, batch_size=2, seed=5)
+    Trainer.restore_iterator(os.path.join(killed_dir, "last_iterator.json"), resumed_loader)
+    resumed_ids = run(
+        resumed_loader,
+        TrainerConfig(max_steps=12, log_every=1, eval_every=10_000, prefetch_depth=3),
+        restored,
+    )
+    assert resumed_ids == full_ids[4:]
+
+
+# --------------------------------------------------------- async checkpointing
+
+
+def test_async_checkpoint_never_blocks_steps(tmp_path, monkeypatch):
+    """Acceptance pin: with a deliberately slow writer, no step waits on
+    checkpoint serialization — and the synchronous kill-switch arm (same slow
+    writer) demonstrably does, proving the injection is real."""
+    make_params, tx, train_step = _id_train_setup()
+    real_save = ckpt_mod.save_checkpoint
+
+    def slow_save(path, state, **kw):
+        time.sleep(0.6)
+        real_save(path, state, **kw)
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
+    monkeypatch.setattr(fit_mod, "save_checkpoint", slow_save)
+
+    def run(ckpt_dir, async_on):
+        monkeypatch.setenv(DISABLE_ASYNC_CHECKPOINT_ENV, "" if async_on else "1")
+        stamps = []
+        trainer = Trainer(
+            TrainerConfig(max_steps=10, log_every=1, eval_every=10_000,
+                          checkpoint_dir=ckpt_dir, checkpoint_every=2),
+            log_fn=lambda line: stamps.append(time.perf_counter()),
+        )
+        trainer.fit(TrainState.create(make_params(), tx), train_step, lambda: make_loader(n=60))
+        return max(b - a for a, b in zip(stamps, stamps[1:]))
+
+    # warm the jit caches so compile time doesn't land in the first gap
+    Trainer(
+        TrainerConfig(max_steps=2, log_every=1, eval_every=10_000), log_fn=lambda _: None
+    ).fit(TrainState.create(make_params(), tx), train_step, lambda: make_loader(n=60))
+
+    async_gap = run(str(tmp_path / "async"), async_on=True)
+    sync_gap = run(str(tmp_path / "sync"), async_on=False)
+    assert sync_gap >= 0.6, f"slow-writer injection ineffective (sync gap {sync_gap:.3f}s)"
+    assert async_gap < 0.35, f"a step blocked on checkpoint serialization ({async_gap:.3f}s)"
+
+    # durability: the final synchronous save is intact and restorable
+    restored = Trainer.restore(
+        os.path.join(str(tmp_path / "async"), "last"), TrainState.create(make_params(), tx)
+    )
+    assert int(restored.step) == 10
+
+
+def test_async_writer_coalesces_to_newest_and_surfaces_errors(monkeypatch):
+    saved = []
+
+    def slow_save(path, state, **kw):
+        time.sleep(0.3)
+        saved.append((path, int(state["step"])))
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
+    writer = AsyncCheckpointWriter()
+    writer.submit("/tmp/ignored", {"step": np.int32(1)})
+    deadline = time.monotonic() + 2.0
+    while not writer._busy and time.monotonic() < deadline:
+        time.sleep(0.01)  # let the writer take snapshot 1 before queueing more
+    writer.submit("/tmp/ignored", {"step": np.int32(2)})
+    writer.submit("/tmp/ignored", {"step": np.int32(3)})  # replaces 2: newest wins
+    writer.close()
+    assert [s for _, s in saved] == [1, 3]
+
+    def broken_save(path, state, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", broken_save)
+    writer = AsyncCheckpointWriter()
+    writer.submit("/tmp/ignored", {"step": np.int32(4)})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        writer.close()
+
+
+def test_sync_checkpoint_resets_throughput_window(tmp_path, monkeypatch):
+    """Satellite fix: the synchronous periodic save must reset the telemetry
+    window so checkpoint IO wall time doesn't pollute tokens/sec (eval already
+    did; checkpoint didn't)."""
+    make_params, tx, train_step = _id_train_setup()
+    real_save = ckpt_mod.save_checkpoint
+
+    def slow_save(path, state, **kw):
+        time.sleep(0.5)
+        real_save(path, state, **kw)
+
+    monkeypatch.setattr(fit_mod, "save_checkpoint", slow_save)
+    lines = []
+    trainer = Trainer(
+        TrainerConfig(max_steps=8, log_every=4, eval_every=10_000, checkpoint_every=2,
+                      checkpoint_dir=str(tmp_path), async_checkpoint=False,
+                      tokens_per_batch=1),
+        log_fn=lambda line: lines.append(json.loads(line)),
+    )
+    trainer.fit(TrainState.create(make_params(), tx), train_step, lambda: make_loader(n=60))
+    # the step-8 window (steps 5-8) contains the step-6 checkpoint; with the
+    # reset its tokens/sec reflects only post-checkpoint steps (fast), without
+    # it the 0.5s of IO caps the figure at ~4/0.5 = 8
+    last = [l for l in lines if "tokens_per_sec" in l][-1]
+    assert last["step"] == 8
+    assert last["tokens_per_sec"] > 20, f"checkpoint IO polluted the window: {last}"
+
+
+# ----------------------------------------------------------------- train_bench
+
+
+def test_train_bench_profile_smoke(tmp_path):
+    """scripts/train_bench.py --profile emits BENCH_train_pipeline.json with
+    the overlapped-vs-synchronous A/B and the host-input vs device-compute
+    split (the per-PR perf artifact; imported, not subprocessed — the jax
+    import tax is already paid)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "train_bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "train_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = tmp_path / "BENCH_train_pipeline.json"
+    result = mod.main([
+        "--preset", "tiny", "--steps", "8", "--window", "4", "--repeats", "1",
+        "--profile", "--profile-out", str(out),
+    ])
+    assert out.exists()
+    assert result["workload"]["host_s_per_batch"] > 0
+    assert result["workload"]["device_s_per_step"] > 0  # the reported split
+    assert result["overlapped"]["steps_per_s"] > 0
+    assert result["synchronous"]["steps_per_s"] > 0
+    assert result["workload"]["interleaved"] is True
+    assert "overlap_speedup" in result
+
+
+# ------------------------------------------------------------- weighted eval
+
+
+def test_evaluate_weights_by_count_and_falls_back_to_batch_size():
+    trainer = Trainer(TrainerConfig(), log_fn=lambda _: None)
+    state = types.SimpleNamespace(params=None)
+
+    # eval steps reporting 'count': weight by real (non-ignored) element count
+    batches = [
+        {"mean": jnp.float32(1.0), "count": jnp.int32(4)},
+        {"mean": jnp.float32(3.0), "count": jnp.int32(1)},
+    ]
+    out = trainer.evaluate(
+        state, lambda p, b: {"loss": b["mean"], "count": b["count"]}, iter(batches), lambda b: b
+    )
+    assert out["loss"] == pytest.approx((1.0 * 4 + 3.0 * 1) / 5)  # not the biased 2.0
+    assert "count" not in out  # reserved key is consumed, not reported
+
+    # no 'count' metric: weight by the batch leading dimension
+    trainer2 = Trainer(TrainerConfig(), log_fn=lambda _: None)
+    batches2 = [
+        {"x": np.zeros((4, 3)), "mean": jnp.float32(1.0)},
+        {"x": np.zeros((1, 3)), "mean": jnp.float32(3.0)},
+    ]
+    out2 = trainer2.evaluate(
+        state, lambda p, b: {"loss": b["mean"]}, iter(batches2), lambda b: b
+    )
+    assert out2["loss"] == pytest.approx((1.0 * 4 + 3.0 * 1) / 5)
