@@ -38,6 +38,9 @@ from typing import Callable, Dict, Iterable, Optional
 
 import jax
 
+from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.retry import RetryPolicy, retry_call
+
 _DONE = object()
 
 
@@ -55,14 +58,26 @@ class DevicePrefetcher:
     ``put``: host batch -> device batch; defaults to ``jax.device_put`` (local
     devices). Mesh training passes ``make_batch_put(mesh)`` (parallel/api.py)
     so batches land sharded over the data axes.
+    ``retry_policy``: transient-IO failures of the per-batch fetch/placement
+    stage (the ``loader.fetch.*`` fault points and the device ``put``) are
+    retried with bounded backoff (reliability/retry.py) before propagating.
+    The SOURCE's own iteration failures are NOT retried — a generator that
+    raised is closed, so replaying ``next()`` would silently skip data.
     """
 
-    def __init__(self, source: Iterable, depth: int = 2, put: Optional[Callable] = None):
+    def __init__(
+        self,
+        source: Iterable,
+        depth: int = 2,
+        put: Optional[Callable] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.source = source
         self.depth = depth
         self._put = put if put is not None else jax.device_put
+        self._retry = retry_policy or RetryPolicy()
         self._stateful = hasattr(source, "state_dict")
         self._resume_state: Optional[Dict] = None
         self._worker: Optional[threading.Thread] = None
@@ -110,9 +125,16 @@ class DevicePrefetcher:
             return False
 
         def worker():
+            def fetch_and_place(b):
+                # the loader.fetch.* fault points fire per ATTEMPT, so a
+                # flaky arming is absorbed by the retry policy and a slow
+                # arming stalls exactly here — off the step loop's thread
+                faults.fire_loader_fetch()
+                return self._put(b)
+
             try:
                 for batch in self.source:
-                    placed = self._put(batch)
+                    placed = retry_call(fetch_and_place, batch, policy=self._retry)
                     snap = self.source.state_dict() if self._stateful else None
                     if not offer((placed, snap)):
                         return

@@ -90,6 +90,16 @@ class TextGenerationPipeline:
             for i, s in enumerate(seqs)
         ]
         engine.run_until_drained()
+        # the pipeline gates lengths/config before routing here and its
+        # engine has no queue bound or deadline, so every handle must have
+        # completed; a non-ok handle would mean silently returning the bare
+        # prompt as if generation succeeded — fail loudly instead
+        bad = [h for h in handles if not h.ok]
+        if bad:
+            raise RuntimeError(
+                "engine did not complete "
+                f"{[(h.request_id, h.status.value, h.finish_reason) for h in bad]}"
+            )
         return [h.output_ids for h in handles]
 
     def __call__(

@@ -1,0 +1,28 @@
+"""Failure-domain hardening (docs/reliability.md).
+
+``faults``: deterministic fault-injection registry — named points armed via
+``FAULTS.arm`` or the ``PERCEIVER_IO_TPU_FAULT`` env, inert by default, used
+by the test suite and ``scripts/chaos_check.py`` to prove the recovery
+contracts of serving, training, and checkpointing.
+``retry``: bounded exponential-backoff retry for transient IO, shared by the
+device prefetcher and the async checkpoint writer.
+"""
+
+from perceiver_io_tpu.reliability.faults import FAULTS, FaultSpec, KilledMidWrite, armed
+from perceiver_io_tpu.reliability.retry import (
+    RetryError,
+    RetryPolicy,
+    TransientIOError,
+    retry_call,
+)
+
+__all__ = [
+    "FAULTS",
+    "FaultSpec",
+    "KilledMidWrite",
+    "RetryError",
+    "RetryPolicy",
+    "TransientIOError",
+    "armed",
+    "retry_call",
+]

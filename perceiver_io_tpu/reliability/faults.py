@@ -1,0 +1,279 @@
+"""Deterministic fault injection: named points, armed on demand, inert by default.
+
+The reliability layer (docs/reliability.md) is only trustworthy if its failure
+paths are EXERCISED, and production failures (preempted TPU mid-checkpoint,
+flaky dataset fetch, NaN batch, deadline overrun) are precisely the ones a
+normal test run never hits. This module provides the injection points that the
+tests and ``scripts/chaos_check.py`` arm:
+
+  ``loader.fetch.slow``      sleep ``value`` seconds per fetch (prefetch worker)
+  ``loader.fetch.flaky``     raise ``TransientIOError`` per qualifying fetch
+                             attempt (absorbed by the retry policy)
+  ``batch.nan``              replace every inexact-dtype leaf of a training
+                             batch with NaN (exercises skip_nonfinite_updates)
+  ``serving.nan``            poison one slot's next-step logits with NaN
+                             (``slot`` param; exercises FAILED containment)
+  ``serving.deadline``       sleep ``value`` seconds at a serving tick
+                             boundary (forces deadline overruns)
+  ``checkpoint.write.flaky`` raise ``TransientIOError`` before serialization
+                             (absorbed by the writer's retry policy)
+  ``checkpoint.write.kill``  leave a partial destination and raise
+                             ``KilledMidWrite`` — a preemption mid-flush
+  ``checkpoint.corrupt``     truncate the largest file of a just-written
+                             checkpoint — a torn write discovered at restore
+
+Arming: ``FAULTS.arm(point, after=..., times=..., value=..., slot=...)`` in
+process, or the env ``PERCEIVER_IO_TPU_FAULT="point:key=val,key=val;point2"``
+for subprocess/chaos drivers. Firing is decided ONLY by deterministic hit
+counters (``after`` qualifying hits skipped, then at most ``times`` firings) —
+no clocks, no randomness — so every chaos scenario replays exactly under a
+fixed seed. With nothing armed, every hook is a dict lookup returning None and
+no numeric value anywhere changes: the no-fault path is bit-inert and the
+float64 parity pins of the training and serving suites run THROUGH these hooks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from perceiver_io_tpu.reliability.retry import TransientIOError
+
+FAULT_ENV = "PERCEIVER_IO_TPU_FAULT"
+
+POINTS = frozenset(
+    {
+        "loader.fetch.slow",
+        "loader.fetch.flaky",
+        "batch.nan",
+        "serving.nan",
+        "serving.deadline",
+        "checkpoint.write.flaky",
+        "checkpoint.write.kill",
+        "checkpoint.corrupt",
+    }
+)
+
+
+class KilledMidWrite(RuntimeError):
+    """Injected preemption mid-checkpoint-flush (``checkpoint.write.kill``)."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed point: fires on qualifying hits ``after < hit <= after+times``."""
+
+    point: str
+    after: int = 0  # skip the first `after` qualifying hits
+    times: Optional[int] = 1  # fire at most this many times; None = every hit
+    value: float = 0.0  # point-specific magnitude (sleep seconds, ...)
+    slot: Optional[int] = None  # serving.nan target slot (None = first occupied)
+    hits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    @classmethod
+    def parse(cls, point: str, spec: str) -> "FaultSpec":
+        """``"after=3,times=2,value=0.5,slot=1"`` (all fields optional;
+        ``times=inf`` = unlimited)."""
+        kw: Dict[str, object] = {}
+        for item in filter(None, (s.strip() for s in spec.split(","))):
+            key, _, val = item.partition("=")
+            if key == "after":
+                kw["after"] = int(val)
+            elif key == "times":
+                kw["times"] = None if val in ("inf", "") else int(val)
+            elif key == "value":
+                kw["value"] = float(val)
+            elif key == "slot":
+                kw["slot"] = int(val)
+            else:
+                raise ValueError(f"unknown fault spec key {key!r} in {point}:{spec}")
+        return cls(point=point, **kw)
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed fault points (prefetch workers and the
+    checkpoint writer thread fire concurrently with the main thread)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, FaultSpec] = {}
+        self._env_loaded = False
+
+    def arm(
+        self,
+        point: str,
+        after: int = 0,
+        times: Optional[int] = 1,
+        value: float = 0.0,
+        slot: Optional[int] = None,
+    ) -> FaultSpec:
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r} (known: {sorted(POINTS)})")
+        spec = FaultSpec(point=point, after=after, times=times, value=value, slot=slot)
+        with self._lock:
+            self._armed[point] = spec
+        return spec
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        """Disarm one point, or everything (``None``) including env arming."""
+        with self._lock:
+            if point is None:
+                self._armed.clear()
+                self._env_loaded = True  # a full disarm also suppresses env re-arming
+            else:
+                self._armed.pop(point, None)
+
+    def reset(self) -> None:
+        """Forget all arming AND re-read the env on next use (test isolation)."""
+        with self._lock:
+            self._armed.clear()
+            self._env_loaded = False
+
+    def armed_points(self):
+        with self._lock:
+            return sorted(self._armed)
+
+    def _load_env_locked(self) -> None:
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        raw = os.environ.get(FAULT_ENV, "").strip()
+        if not raw:
+            return
+        for entry in filter(None, (s.strip() for s in raw.split(";"))):
+            point, _, spec = entry.partition(":")
+            if point not in POINTS:
+                raise ValueError(
+                    f"{FAULT_ENV} names unknown fault point {point!r} (known: {sorted(POINTS)})"
+                )
+            self._armed[point] = FaultSpec.parse(point, spec)
+
+    def fire(self, point: str) -> Optional[FaultSpec]:
+        """Count a hit at ``point``; return the spec iff this hit fires.
+        The fast inert path (nothing armed) is one lock + dict lookup."""
+        with self._lock:
+            self._load_env_locked()
+            spec = self._armed.get(point)
+            if spec is None:
+                return None
+            spec.hits += 1
+            if spec.hits <= spec.after:
+                return None
+            if spec.times is not None and spec.fired >= spec.times:
+                return None
+            spec.fired += 1
+            return spec
+
+
+FAULTS = FaultRegistry()
+
+
+@contextmanager
+def armed(point: str, **kwargs):
+    """Arm ``point`` for the duration of a with-block (test helper)."""
+    spec = FAULTS.arm(point, **kwargs)
+    try:
+        yield spec
+    finally:
+        FAULTS.disarm(point)
+
+
+# --------------------------------------------------------------- fire helpers
+# Call-site wrappers so instrumented modules stay one-line readable. Each is a
+# no-op returning instantly when its point is not armed.
+
+
+def fire_loader_fetch() -> None:
+    """Prefetch-worker fetch/place hook: slow (sleep) and flaky (transient
+    raise, absorbed by the worker's retry policy)."""
+    spec = FAULTS.fire("loader.fetch.slow")
+    if spec is not None:
+        time.sleep(spec.value or 0.05)
+    spec = FAULTS.fire("loader.fetch.flaky")
+    if spec is not None:
+        raise TransientIOError(
+            f"injected flaky loader fetch (firing {spec.fired}"
+            f"{'' if spec.times is None else f'/{spec.times}'})"
+        )
+
+
+def poison_batch(batch):
+    """Training-loop hook: when ``batch.nan`` fires, every inexact-dtype leaf
+    of the batch becomes all-NaN (integer token batches pass through — the
+    point targets float feature pipelines). Returns the batch object itself,
+    unchanged and uncopied, when not armed."""
+    spec = FAULTS.fire("batch.nan")
+    if spec is None:
+        return batch
+    import jax
+    import jax.numpy as jnp
+
+    def nan_like(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    return jax.tree.map(nan_like, batch)
+
+
+def fire_serving_tick_delay() -> None:
+    """Serving-engine tick hook: an injected stall that pushes wall clock past
+    request deadlines (the deadline-overrun scenario)."""
+    spec = FAULTS.fire("serving.deadline")
+    if spec is not None:
+        time.sleep(spec.value or 0.05)
+
+
+def fire_serving_nan() -> Optional[FaultSpec]:
+    """Serving-engine poison hook: the engine NaNs the spec's slot logits."""
+    return FAULTS.fire("serving.nan")
+
+
+def fire_checkpoint_write(path: str) -> None:
+    """Checkpoint-save hook (runs before serialization): flaky (transient
+    raise, absorbed by the writer's retry policy) and kill (leave the partial
+    destination a preemption mid-flush would, then raise)."""
+    spec = FAULTS.fire("checkpoint.write.flaky")
+    if spec is not None:
+        raise TransientIOError(
+            f"injected flaky checkpoint write (firing {spec.fired}"
+            f"{'' if spec.times is None else f'/{spec.times}'})"
+        )
+    spec = FAULTS.fire("checkpoint.write.kill")
+    if spec is not None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "_PARTIAL_WRITE"), "w") as f:
+            f.write("injected kill mid-flush: this checkpoint is incomplete\n")
+        raise KilledMidWrite(f"injected kill mid-checkpoint-flush at {path}")
+
+
+def fire_checkpoint_corrupt(path: str) -> bool:
+    """Post-save hook: when armed, corrupt the just-written checkpoint the way
+    a torn write would (truncate its largest file) — discovered at restore."""
+    spec = FAULTS.fire("checkpoint.corrupt")
+    if spec is None:
+        return False
+    corrupt_checkpoint_dir(path)
+    return True
+
+
+def corrupt_checkpoint_dir(path: str) -> str:
+    """Truncate the largest file under ``path`` to half its size (also used
+    directly by tests). Returns the mutilated file's path."""
+    largest, size = None, -1
+    for root, _, files in os.walk(path):
+        for name in files:
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None:
+        raise FileNotFoundError(f"no files to corrupt under {path}")
+    with open(largest, "r+b") as f:
+        f.truncate(max(size // 2, 0))
+    return largest

@@ -1,0 +1,85 @@
+"""Transient-IO retry: bounded exponential backoff with deterministic jitter.
+
+One shared helper for every spot that talks to storage or pays a host-side
+fetch (the data-loader fetch/placement in ``data/prefetch.py`` and checkpoint
+serialization in ``training/checkpoint.py``): transient failures — the kind a
+shared filesystem or an object store throws under load — are retried a bounded
+number of times with exponentially growing, jittered delays, and a persistent
+failure surfaces with the full error chain intact (``RetryError`` raised
+``from`` the last attempt's exception, whose ``__context__`` chain holds the
+earlier ones).
+
+Jitter is DETERMINISTIC: each ``retry_call`` seeds its own ``random.Random``,
+so the sleep schedule for a given attempt sequence is reproducible — the
+fault-injection tests (reliability/faults.py) can pin exact behavior without
+mocking the clock. Jitter still does its real job (decorrelating herds of
+workers) because every worker's failure TIMES differ, not its schedule.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+
+class TransientIOError(OSError):
+    """A failure the caller believes is transient and safe to retry — raised
+    by the fault-injection harness and available for loaders/stores that can
+    classify their own errors."""
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; raised ``from`` the final attempt's exception."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-attempt backoff schedule. ``attempts`` counts TOTAL calls (the
+    first try included), so ``attempts=1`` disables retrying entirely."""
+
+    attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 0.25  # uniform [0, jitter) fraction added to each delay
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, ConnectionError, TimeoutError)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Delay before retry number ``attempt`` (1-based)."""
+        d = min(self.max_delay_s, self.base_delay_s * (2 ** (attempt - 1)))
+        return d * (1.0 + self.jitter * rng.random())
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: Optional[RetryPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying ``policy.retry_on`` failures up
+    to ``policy.attempts`` total tries. ``on_retry(attempt, exc, delay)`` is
+    invoked before each backoff sleep (metrics/log hook). Exceptions outside
+    ``retry_on`` propagate immediately — retrying an unknown failure mode
+    (e.g. a programming error) just hides it."""
+    policy = policy or RetryPolicy()
+    if policy.attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {policy.attempts}")
+    rng = random.Random(0x5EED)  # deterministic schedule; see module docstring
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:  # noqa: PERF203 — the retry IS the point
+            last = e
+            if attempt >= policy.attempts:
+                break
+            delay = policy.delay(attempt, rng)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+    raise RetryError(
+        f"{getattr(fn, '__name__', repr(fn))} failed after {policy.attempts} attempts"
+    ) from last

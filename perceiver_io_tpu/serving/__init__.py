@@ -7,6 +7,7 @@ surface. ``scripts/serve_bench.py`` drives a synthetic workload through it.
 """
 
 from perceiver_io_tpu.serving.engine import (
+    TERMINAL_STATUSES,
     RequestStatus,
     ServedRequest,
     ServingEngine,
@@ -23,6 +24,7 @@ __all__ = [
     "ServingEngine",
     "SlotScheduler",
     "SlotState",
+    "TERMINAL_STATUSES",
     "default_prefill_buckets",
     "load_metrics_jsonl",
 ]

@@ -35,6 +35,24 @@ Design (see docs/serving.md for the full writeup):
     requests and admits queued ones between steps. ``max_new_tokens`` is a
     host counter, not a compiled loop bound, so mixed lengths are free.
 
+Admission control (docs/reliability.md): the queue is BOUNDED — once the
+backlog exceeds free slot capacity by ``max_queue_depth``, a submit returns a
+handle already terminal in ``REJECTED`` instead of letting the backlog grow
+without limit — and over-long prompts are rejected
+the same way at submit time (a well-formed request the pool cannot serve is an
+admission outcome, not a crash; malformed requests still raise). Requests
+carry an optional ``deadline_s`` TTL enforced at tick boundaries: expired
+requests — queued or running — are evicted as ``TIMED_OUT`` while survivors'
+outputs stay token-identical (slots never interact across the batch axis;
+f64-pinned). Non-finite logits on an active slot (numerical blowup, poisoned
+weights) are CONTAINED: the decode step reports per-slot finiteness alongside
+the sampled tokens (same single sync), the poisoned slot is evicted as
+``FAILED`` with its cache/state rows zeroed, and slot-mates are unaffected.
+``drain()`` is the graceful shutdown: the queued backlog is rejected, active
+slots run to completion, and further submits are refused. With no deadline
+set, no bound configured, and no fault armed, all of this is bit-inert —
+compile counts and greedy parity are unchanged (pinned).
+
 Kill-switches: ``PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL=1`` pins the
 ladder at the single full-window bucket (the PR-1 behavior);
 ``PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1`` disables live-length masking
@@ -63,6 +81,7 @@ import numpy as np
 
 from perceiver_io_tpu.generation.generate import GenerationConfig, _cache_dtype
 from perceiver_io_tpu.generation.sampling import process_logits_batched, sample_token_batched
+from perceiver_io_tpu.reliability import faults
 from perceiver_io_tpu.serving.metrics import EngineMetrics
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
@@ -105,7 +124,16 @@ class SlotState(flax.struct.PyTreeNode):
 class RequestStatus(str, Enum):
     QUEUED = "queued"
     RUNNING = "running"
-    FINISHED = "finished"
+    FINISHED = "finished"  # completed normally (eos / length)
+    REJECTED = "rejected"  # refused admission (queue bound, prompt, draining)
+    TIMED_OUT = "timed_out"  # deadline expired, queued or running
+    FAILED = "failed"  # evicted by non-finite-logits containment
+
+
+# statuses from which a request never advances again
+TERMINAL_STATUSES = frozenset(
+    {RequestStatus.FINISHED, RequestStatus.REJECTED, RequestStatus.TIMED_OUT, RequestStatus.FAILED}
+)
 
 
 @dataclass
@@ -119,17 +147,36 @@ class ServedRequest:
     status: RequestStatus = RequestStatus.QUEUED
     slot: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
-    finish_reason: Optional[str] = None  # "eos" | "length"
+    # "eos" | "length" | rejection/expiry/failure detail ("queue_full",
+    # "prompt_too_long", "draining", "deadline", "nonfinite_logits")
+    finish_reason: Optional[str] = None
     submitted_at: float = 0.0
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
+    deadline_s: Optional[float] = None  # TTL from submit; enforced at ticks
 
     @property
     def done(self) -> bool:
+        """Terminal — FINISHED, REJECTED, TIMED_OUT, or FAILED. Check
+        ``status``/``ok`` to distinguish success from an admission-control or
+        containment outcome."""
+        return self.status in TERMINAL_STATUSES
+
+    @property
+    def ok(self) -> bool:
         return self.status is RequestStatus.FINISHED
 
+    @property
+    def deadline_at(self) -> Optional[float]:
+        """Absolute ``time.perf_counter()`` expiry, or None (no deadline)."""
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
     def result(self) -> np.ndarray:
-        """Generated tokens (prompt excluded), truncated at EOS inclusive."""
+        """Generated tokens (prompt excluded), truncated at EOS inclusive.
+        TIMED_OUT requests keep the tokens decoded before expiry; REJECTED
+        and FAILED requests yield an empty/partial array — check ``ok``."""
         return np.asarray(self.output_ids, np.int32)
 
 
@@ -181,6 +228,8 @@ class ServingEngine:
         cache_dtype=None,
         metrics_jsonl: Optional[str] = None,
         prefill_buckets: Optional[Sequence[int]] = None,
+        max_queue_depth: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
     ):
         self.model = model
         self.params = params
@@ -191,6 +240,19 @@ class ServingEngine:
         self.finished: List[ServedRequest] = []
         self._ids = itertools.count()
         self._requests: Dict[int, ServedRequest] = {}
+        # admission control (docs/reliability.md): None = unbounded/undeadlined
+        # — the pre-hardening behavior, bit-inert. max_queue_depth bounds the
+        # backlog beyond available slot capacity (0 = accept only what free
+        # slots will absorb at the next tick).
+        if max_queue_depth is not None and max_queue_depth < 0:
+            raise ValueError(f"max_queue_depth must be >= 0, got {max_queue_depth}")
+        self.max_queue_depth = max_queue_depth
+        self.default_deadline_s = default_deadline_s
+        self._draining = False
+        # ticks skip the deadline scan entirely until any request carries one
+        # — a no-deadline engine with a deep backlog must not pay O(queue)
+        # predicate calls per generated token
+        self._deadlines_seen = default_deadline_s is not None
 
         cfg = model.config
         self._vocab = cfg.vocab_size
@@ -296,6 +358,12 @@ class ServingEngine:
             # Mirrors _generate_single's loop body per row: process logits ->
             # sample -> one cached model step. Inactive rows decode their pad
             # token; their outputs are never harvested.
+            # ``finite`` is the containment probe (docs/reliability.md): per
+            # ACTIVE slot, were the logits this step sampled from all finite?
+            # Computed in the same program, harvested with the same device
+            # sync as the tokens — detection costs no extra host round-trip,
+            # and the token math is untouched (parity pins unaffected).
+            finite = jnp.all(jnp.isfinite(state.next_logits), axis=-1) | ~state.active
             processed = process_logits_batched(
                 state.next_logits, state.temperature, state.top_k, state.top_p
             )
@@ -312,12 +380,32 @@ class ServingEngine:
                 next_logits=jnp.where(state.active[:, None], logits_t[:, -1], state.next_logits),
                 rng=jnp.where(state.active[:, None], keys[:, 0], state.rng),
             )
-            return tok, cache, state
+            return tok, finite, cache, state
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def quarantine(cache, slot):
+            # containment eviction: zero every per-slot row of a poisoned
+            # slot's cache and reset its pad/shift/live fields to the free-slot
+            # canonical form (live pinned at full capacity, matching __init__),
+            # so no non-finite value survives in the pool and the next
+            # admission's write_slot starts from the same state as a fresh slot
+            return cache.replace(
+                ca=cache.ca.replace(
+                    k=cache.ca.k.at[slot].set(0), v=cache.ca.v.at[slot].set(0)
+                ),
+                sa=cache.sa.replace(
+                    k=cache.sa.k.at[:, slot].set(0), v=cache.sa.v.at[:, slot].set(0)
+                ),
+                pad_slots=cache.pad_slots.at[slot].set(False),
+                shift=cache.shift.at[slot].set(0),
+                live=cache.live.at[slot].set(cache.ca.capacity),
+            )
 
         self._jit_prefill = prefill_one
         self._jit_install = install
         self._jit_release = release
         self._jit_decode = decode_step
+        self._jit_quarantine = quarantine
 
     @property
     def decode_compilations(self) -> int:
@@ -335,10 +423,21 @@ class ServingEngine:
         prompt_ids: Sequence[int],
         config: Optional[GenerationConfig] = None,
         rng: Optional[jax.Array] = None,
+        deadline_s: Optional[float] = None,
         **kwargs,
     ) -> ServedRequest:
         """Queue one request; returns its handle. ``config``/kwargs follow
-        ``generate()``'s convention (pass one or the other)."""
+        ``generate()``'s convention (pass one or the other). ``deadline_s``
+        is a TTL from now (falls back to the engine's ``default_deadline_s``);
+        an expired request is evicted ``TIMED_OUT`` at the next tick.
+
+        MALFORMED requests (empty prompt, unservable config) raise ValueError
+        — they are caller bugs. WELL-FORMED requests the pool cannot serve
+        right now (queue at its bound, prompt longer than the window, engine
+        draining) return a handle already terminal in ``REJECTED`` — the
+        admission-control path, validated here at submit instead of crashing
+        inside a prefill the request already queued behind (check
+        ``handle.ok``)."""
         if config is None:
             config = GenerationConfig(**kwargs)
         elif kwargs:
@@ -347,8 +446,9 @@ class ServingEngine:
         if reason is not None:
             raise ValueError(f"GenerationConfig not servable by the engine: {reason}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        if not 0 < prompt.size <= self._window:
-            raise ValueError(f"Input sequence length out of valid range [1..{self._window}]")
+        if prompt.size < 1:
+            raise ValueError("prompt must be non-empty (over-long prompts are "
+                             "REJECTED at admission, empty ones are malformed)")
         if rng is None:
             rng = jax.random.PRNGKey(0)
         elif jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
@@ -361,10 +461,39 @@ class ServingEngine:
             config=config,
             rng=rng,
             submitted_at=time.perf_counter(),
+            deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
         )
+        if request.deadline_s is not None:
+            self._deadlines_seen = True
+        self.metrics.record_submit(request.request_id, int(prompt.size))
+        if self._draining:
+            return self._reject(request, "draining")
+        if prompt.size > self._window:
+            return self._reject(request, "prompt_too_long")
+        # the bound limits the backlog BEYOND available slot capacity: every
+        # submit transits the queue (admission happens at tick boundaries),
+        # so a raw queue_depth check would reject a burst into an idle
+        # engine while its slots sit free. max_queue_depth=0 therefore
+        # means "no waiting beyond what the free slots will absorb".
+        if (
+            self.max_queue_depth is not None
+            and self.scheduler.queue_depth - self.scheduler.free_slots >= self.max_queue_depth
+        ):
+            return self._reject(request, "queue_full")
         self._requests[request.request_id] = request
         self.scheduler.enqueue(request)
-        self.metrics.record_submit(request.request_id, int(prompt.size))
+        return request
+
+    def _reject(self, request: ServedRequest, reason: str) -> ServedRequest:
+        """Refuse admission: the handle goes terminal immediately and is
+        still drained through ``finished`` so batch callers get one result
+        per submit."""
+        self._requests.pop(request.request_id, None)
+        request.status = RequestStatus.REJECTED
+        request.finish_reason = reason
+        request.finished_at = time.perf_counter()
+        self.finished.append(request)
+        self.metrics.record_reject(request.request_id, reason)
         return request
 
     # ------------------------------------------------------------------- admit
@@ -418,35 +547,99 @@ class ServingEngine:
             prefill_s=now - t0, bucket=bucket,
         )
 
-    def _evict(self, slot: int, request: ServedRequest, reason: str) -> None:
+    def _evict(
+        self, slot: int, request: ServedRequest, reason: str,
+        status: RequestStatus = RequestStatus.FINISHED,
+    ) -> None:
         self.scheduler.release(slot)
         self._state = self._jit_release(self._state, slot)
-        request.status = RequestStatus.FINISHED
+        request.status = status
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
         request.slot = None
         self._requests.pop(request.request_id, None)  # engines are long-lived: no per-request residue
         self.finished.append(request)
-        self.metrics.record_finish(request.request_id, slot, len(request.output_ids), reason)
+        self.metrics.record_finish(
+            request.request_id, slot, len(request.output_ids), reason,
+            status=status.value,
+        )
+
+    # --------------------------------------------------------------- deadlines
+    def _expire_deadlines(self, now: float) -> None:
+        """Tick-boundary TTL enforcement: expired QUEUED requests leave the
+        queue without ever costing a prefill; expired RUNNING requests free
+        their slot before the decode dispatch, so the tick never spends device
+        work on a request nobody is waiting for. Survivors are untouched —
+        slots never interact across the batch axis, so their token streams
+        stay identical to a run without the expiry (f64-pinned)."""
+        expired = self.scheduler.prune_queue(
+            lambda r: r.deadline_at is not None and now >= r.deadline_at
+        )
+        for request in expired:
+            self._requests.pop(request.request_id, None)
+            request.status = RequestStatus.TIMED_OUT
+            request.finish_reason = "deadline"
+            request.finished_at = now
+            self.finished.append(request)
+            self.metrics.record_timeout_queued(request.request_id)
+        for slot, request in list(self.scheduler.occupied()):
+            if request.deadline_at is not None and now >= request.deadline_at:
+                self._evict(slot, request, "deadline", status=RequestStatus.TIMED_OUT)
+
+    def _maybe_inject_nan(self) -> None:
+        """serving.nan fault point (reliability/faults.py): poison one slot's
+        next-step logits — the containment path must then evict exactly that
+        slot as FAILED while slot-mates decode on untouched."""
+        spec = faults.fire_serving_nan()
+        if spec is None:
+            return
+        slot = spec.slot
+        if slot is None:
+            occupied = next(iter(self.scheduler.occupied()), None)
+            if occupied is None:
+                return
+            slot = occupied[0]
+        self._state = self._state.replace(
+            next_logits=self._state.next_logits.at[slot].set(jnp.nan)
+        )
 
     # -------------------------------------------------------------------- step
     def step(self) -> bool:
-        """One scheduler tick: admit queued requests into free slots, advance
-        every occupied slot one token, harvest/evict finished requests.
-        Returns True while work remains (occupied slots or queued requests)."""
-        for slot, request in self.scheduler.pop_admissible():
-            self._admit(slot, request)
+        """One scheduler tick: expire deadlines, admit queued requests into
+        free slots, advance every occupied slot one token, harvest/evict
+        finished (or contained) requests. Returns True while work remains
+        (occupied slots or queued requests)."""
+        faults.fire_serving_tick_delay()  # injected stall (deadline-overrun chaos)
+        if self._deadlines_seen:
+            self._expire_deadlines(time.perf_counter())
+        if not self._draining:
+            for slot, request in self.scheduler.pop_admissible():
+                self._admit(slot, request)
+        self._maybe_inject_nan()
         occupied = list(self.scheduler.occupied())
         if not occupied:
             return self.scheduler.has_work
 
         t0 = time.perf_counter()
-        tok, self._cache, self._state = self._jit_decode(self.params, self._cache, self._state)
+        tok, finite, self._cache, self._state = self._jit_decode(
+            self.params, self._cache, self._state
+        )
         tok = np.asarray(tok)  # blocks: the step's device sync point
+        finite = np.asarray(finite)  # already on host after the sync above
         decode_s = time.perf_counter() - t0
-        self.metrics.record_decode_step(len(occupied), decode_s, tokens=len(occupied))
+        # tokens_generated counts USEFUL tokens only: a quarantined slot's
+        # garbage sample is never emitted, so it must not inflate the count
+        useful = sum(1 for slot, _ in occupied if finite[slot])
+        self.metrics.record_decode_step(len(occupied), decode_s, tokens=useful)
 
         for slot, request in occupied:
+            if not finite[slot]:
+                # containment: the token sampled from non-finite logits is
+                # garbage — never emitted — and the slot's cache/state rows
+                # are zeroed so nothing non-finite survives in the pool
+                self._cache = self._jit_quarantine(self._cache, slot)
+                self._evict(slot, request, "nonfinite_logits", status=RequestStatus.FAILED)
+                continue
             token = int(tok[slot])
             request.output_ids.append(token)
             cfg = request.config
@@ -468,3 +661,13 @@ class ServingEngine:
                 raise RuntimeError(f"engine not drained after {max_steps} steps")
         drained, self.finished = self.finished, []
         return drained
+
+    def drain(self, max_steps: Optional[int] = None) -> List[ServedRequest]:
+        """Graceful shutdown: stop admitting (subsequent submits are
+        REJECTED), reject the queued backlog, and run the ACTIVE slots to
+        completion — in-flight work is finished, not dropped. Returns the
+        drained terminal handles (completion order, rejected backlog first)."""
+        self._draining = True
+        for request in self.scheduler.prune_queue(lambda r: True):
+            self._reject(request, "draining")
+        return self.run_until_drained(max_steps=max_steps)

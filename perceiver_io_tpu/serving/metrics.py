@@ -22,8 +22,15 @@ Schema history:
     lifetime) and a per-admission ``bucket`` field on ``admit`` events (the
     bucketed-prefill ladder). With non-blocking
     admission ``prefill_s`` measures DISPATCH time — device prefill cost
-    lands in the next decode-step sync. ``load_metrics_jsonl`` reads both
-    versions (v1 snapshots are normalized with ``None`` percentiles).
+    lands in the next decode-step sync.
+  * ``serving-metrics/v3`` — adds the admission-control outcome counters
+    ``rejected`` (queue bound / over-long prompt / draining engine),
+    ``timed_out`` (deadline expiry, queued or running), and ``failed``
+    (non-finite-logits containment) to snapshots, plus ``reject`` events and
+    a ``status`` field on ``finish`` events (docs/reliability.md).
+    ``queue_depth`` was already snapshotted. ``load_metrics_jsonl`` reads all
+    versions (older snapshots are normalized with ``None`` for the fields
+    their writers did not record).
 """
 
 from __future__ import annotations
@@ -36,8 +43,9 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v2"
-KNOWN_SCHEMAS = ("serving-metrics/v1", "serving-metrics/v2")
+SCHEMA = "serving-metrics/v3"
+KNOWN_SCHEMAS = ("serving-metrics/v1", "serving-metrics/v2", "serving-metrics/v3")
+_V3_COUNTERS = ("rejected", "timed_out", "failed")
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -97,6 +105,11 @@ def load_metrics_jsonl(path: str) -> Dict:
                 none_lat = {"mean": None, "max": None, "p50": None, "p95": None}
                 snap.setdefault("prefill_s", dict(none_lat))
                 snap.setdefault("decode_step_s", dict(none_lat))
+            if schema != "serving-metrics/v3":
+                # pre-v3 writers had no admission-control outcomes: None, not
+                # 0 — "not recorded" must stay distinguishable from "none"
+                for k in _V3_COUNTERS:
+                    snap.setdefault(k, None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -110,7 +123,10 @@ class EngineMetrics:
 
     requests_submitted: int = 0
     requests_admitted: int = 0
-    requests_finished: int = 0
+    requests_finished: int = 0  # successful completions (eos / length)
+    requests_rejected: int = 0  # refused at submit (queue bound, prompt, drain)
+    requests_timed_out: int = 0  # deadline expiry, queued or running
+    requests_failed: int = 0  # evicted by non-finite-logits containment
     tokens_generated: int = 0  # useful tokens only (active slots)
     decode_steps: int = 0
     prefills: int = 0
@@ -166,10 +182,36 @@ class EngineMetrics:
         self._emit("decode_step", active_slots=active_slots,
                    seconds=round(seconds, 6), tokens=tokens)
 
-    def record_finish(self, request_id: int, slot: int, new_tokens: int, reason: str) -> None:
-        self.requests_finished += 1
+    def record_finish(
+        self, request_id: int, slot: int, new_tokens: int, reason: str,
+        status: str = "finished",
+    ) -> None:
+        """Terminal event for a request that held a slot. ``status`` routes
+        the counter: "finished" (success), "timed_out", or "failed"."""
+        if status == "timed_out":
+            self.requests_timed_out += 1
+        elif status == "failed":
+            self.requests_failed += 1
+        else:
+            self.requests_finished += 1
         self._emit("finish", request_id=request_id, slot=slot,
-                   new_tokens=new_tokens, reason=reason)
+                   new_tokens=new_tokens, reason=reason, status=status)
+
+    def record_reject(self, request_id: int, reason: str) -> None:
+        """Terminal event for a request refused admission (it was submitted —
+        ``record_submit`` counted it and bumped ``queue_depth`` — but never
+        reached a slot)."""
+        self.requests_rejected += 1
+        self.queue_depth = max(self.queue_depth - 1, 0)
+        self._emit("reject", request_id=request_id, reason=reason)
+
+    def record_timeout_queued(self, request_id: int, reason: str = "deadline") -> None:
+        """Terminal event for a QUEUED request whose deadline expired before
+        it ever reached a slot."""
+        self.requests_timed_out += 1
+        self.queue_depth = max(self.queue_depth - 1, 0)
+        self._emit("finish", request_id=request_id, slot=None, new_tokens=0,
+                   reason=reason, status="timed_out")
 
     # ---------------------------------------------------------------- snapshot
     def snapshot(self) -> Dict:
@@ -180,6 +222,9 @@ class EngineMetrics:
             "requests_submitted": self.requests_submitted,
             "requests_admitted": self.requests_admitted,
             "requests_finished": self.requests_finished,
+            "rejected": self.requests_rejected,
+            "timed_out": self.requests_timed_out,
+            "failed": self.requests_failed,
             "queue_depth": self.queue_depth,
             "tokens_generated": self.tokens_generated,
             "decode_steps": self.decode_steps,

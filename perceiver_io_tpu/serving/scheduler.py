@@ -21,7 +21,7 @@ Design constraints inherited from the device side (docs/serving.md):
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Generic, Iterator, List, Optional, Tuple, TypeVar
+from typing import Callable, Deque, Generic, Iterator, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -48,6 +48,10 @@ class SlotScheduler(Generic[T]):
         return self.num_slots - len(self._free)
 
     @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
     def has_work(self) -> bool:
         return bool(self._queue) or self.active_slots > 0
 
@@ -63,6 +67,21 @@ class SlotScheduler(Generic[T]):
     # ------------------------------------------------------------------ policy
     def enqueue(self, request: T) -> None:
         self._queue.append(request)
+
+    def prune_queue(self, predicate: Callable[[T], bool]) -> List[T]:
+        """Remove and return every QUEUED request matching ``predicate``,
+        preserving FIFO order among survivors — the admission-control
+        primitive behind deadline expiry of waiting requests and the
+        reject-the-backlog step of a graceful drain (serving/engine.py).
+        Requests already occupying slots are untouched (evicting a running
+        request is the engine's job: it owns the device state)."""
+        kept: Deque[T] = deque()
+        removed: List[T] = []
+        for request in self._queue:
+            (removed if predicate(request) else kept).append(request)
+        if removed:  # nothing matched: keep the original deque untouched
+            self._queue = kept
+        return removed
 
     def pop_admissible(self) -> Iterator[Tuple[int, T]]:
         """Yield (slot, request) admissions until slots or queue run out.

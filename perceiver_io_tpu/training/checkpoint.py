@@ -4,18 +4,43 @@ SURVEY.md §5 checkpoint/resume).
 
 Checkpoints are sharding-aware: restoring under a mesh places shards directly on
 their devices (no host round-trip), which Lightning/FSDP could not do.
+
+Crash-safe lineage (docs/reliability.md): every lineage save writes a sidecar
+MANIFEST (step, leaf structure, per-leaf crc32 checksums) via the audited
+``atomic_write_json`` path, AFTER the state commit — a valid manifest therefore
+implies a completed write, and the checksums catch torn writes after the fact.
+Before overwriting a named checkpoint (``last``), the previous generation is
+rotated to ``<name>.prev`` (O(1) renames, no extra serialization), so a kill at
+ANY byte of the new write leaves a restorable ancestor on disk.
+``restore_latest_valid`` walks a checkpoint directory newest-first, validates
+against manifests, and falls back past corrupt/partial checkpoints — the exact
+failure a TPU preemption mid-``AsyncCheckpointWriter`` flush produces.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import shutil
 import threading
-from typing import Any, Dict, Optional
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.retry import RetryPolicy, retry_call
+
+MANIFEST_SCHEMA = "ckpt-manifest/v1"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity validation (missing, partial, or its
+    contents disagree with the manifest)."""
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
@@ -25,7 +50,7 @@ def _checkpointer() -> ocp.StandardCheckpointer:
 def atomic_write_json(path: str, payload: Any, indent: Optional[int] = None) -> None:
     """Write JSON via tmp + rename so a kill mid-write can never leave a
     corrupt file — the one audited code path for every sidecar artifact
-    (iterator snapshots, best-metric records, bench outputs)."""
+    (iterator snapshots, best-metric records, manifests, bench outputs)."""
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=indent)
@@ -36,9 +61,15 @@ def atomic_write_json(path: str, payload: Any, indent: Optional[int] = None) -> 
 
 def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
     path = os.path.abspath(os.fspath(path))
+    # fault points (docs/reliability.md): flaky raises TransientIOError for the
+    # caller's retry policy; kill leaves the partial destination a preemption
+    # mid-flush would; corrupt tears the committed bytes post-hoc. All inert
+    # unless armed.
+    faults.fire_checkpoint_write(path)
     ckpt = _checkpointer()
     ckpt.save(path, state, force=force)
     ckpt.wait_until_finished()  # StandardCheckpointer saves asynchronously
+    faults.fire_checkpoint_corrupt(path)
 
 
 def load_pytree(path: str) -> Any:
@@ -59,6 +90,278 @@ def restore_checkpoint(path: str, template: Any, shardings: Optional[Any] = None
     else:
         targets = jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), template)
     return _checkpointer().restore(path, targets)
+
+
+# ----------------------------------------------------------- lineage/integrity
+
+
+def manifest_path(path: str) -> str:
+    """Sidecar manifest for the checkpoint at ``path`` (a SIBLING file — orbax
+    owns the checkpoint directory's contents)."""
+    return os.path.abspath(os.fspath(path)).rstrip(os.sep) + ".manifest.json"
+
+
+def _leaf_entries(state: Any) -> List[Dict]:
+    """Per-leaf (path, shape, dtype, crc32) records. Paths are kept for
+    diagnostics only: container kinds differ between a live TrainState and the
+    dict tree orbax restores, so validation compares the SORTED multiset of
+    (shape, dtype, crc) triplets plus the leaf count — which detects
+    truncation, substitution, and bit corruption all the same."""
+    entries = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        arr = np.asarray(leaf)
+        entries.append(
+            {
+                "path": "/".join(re.findall(r"\w+", jax.tree_util.keystr(keypath))),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF,
+            }
+        )
+    return entries
+
+
+def _checksum_triplets(entries: List[Dict]) -> List[Tuple]:
+    return sorted((e["dtype"], tuple(e["shape"]), e["crc32"]) for e in entries)
+
+
+def infer_step(state: Any) -> Optional[int]:
+    """Best-effort scalar ``step`` extraction from a TrainState-like pytree."""
+    step = getattr(state, "step", None)
+    if step is None and isinstance(state, dict):
+        step = state.get("step")
+    try:
+        arr = np.asarray(step)
+        return int(arr) if arr.size == 1 else None
+    except Exception:
+        return None
+
+
+def build_manifest(state: Any, step: Optional[int] = None) -> Dict:
+    """Integrity manifest of a state pytree. Callers should pass a HOST tree
+    (``save_checkpoint_lineage`` snapshots once and feeds the same tree to
+    orbax and here; the async writer already holds one) — per-leaf
+    ``np.asarray`` on device arrays would otherwise repeat the full D2H
+    transfer the save just paid. The crc32 pass itself is the integrity
+    cost (~1 GB/s) and runs on whichever thread performs the write."""
+    entries = _leaf_entries(state)
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "step": step if step is not None else infer_step(state),
+        "leaf_count": len(entries),
+        "leaves": entries,
+        "written_at": round(time.time(), 3),
+    }
+
+
+def write_manifest(path: str, state: Any, step: Optional[int] = None) -> Dict:
+    manifest = build_manifest(state, step=step)
+    atomic_write_json(manifest_path(path), manifest)
+    return manifest
+
+
+def verify_checkpoint(path: str) -> Dict:
+    """Validate the checkpoint at ``path`` against its manifest; returns the
+    manifest on success, raises ``CheckpointCorruptError`` on any mismatch
+    (missing/unparsable manifest, unreadable checkpoint, leaf-count or
+    checksum disagreement)."""
+    path = os.path.abspath(os.fspath(path))
+    mp = manifest_path(path)
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(f"checkpoint {path} does not exist")
+    if not os.path.exists(mp):
+        raise CheckpointCorruptError(f"checkpoint {path} has no manifest ({mp})")
+    try:
+        with open(mp) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(f"unreadable manifest {mp}: {e}") from e
+    if manifest.get("schema") != MANIFEST_SCHEMA:
+        raise CheckpointCorruptError(
+            f"unknown manifest schema {manifest.get('schema')!r} in {mp}"
+        )
+    try:
+        tree = load_pytree(path)
+    except Exception as e:  # noqa: BLE001 — any restore failure means partial/corrupt
+        raise CheckpointCorruptError(f"checkpoint {path} failed to load: {e}") from e
+    actual = _leaf_entries(tree)
+    if len(actual) != manifest["leaf_count"]:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has {len(actual)} leaves, manifest says "
+            f"{manifest['leaf_count']}"
+        )
+    if _checksum_triplets(actual) != _checksum_triplets(manifest["leaves"]):
+        raise CheckpointCorruptError(f"checkpoint {path} failed checksum validation")
+    return manifest
+
+
+def _manifest_readable(path: str) -> bool:
+    try:
+        with open(manifest_path(path)) as f:
+            json.load(f)
+        return True
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def rotate_previous(path: str, aux_paths: Tuple[str, ...] = ()) -> bool:
+    """Move the current generation at ``path`` (+ manifest + ``aux_paths``
+    whose basenames extend the checkpoint's) to ``<path>.prev`` before a new
+    write, so a kill mid-write leaves a restorable ancestor. Rename order is
+    chosen so the worst mid-rotation kill leaves the data directory either
+    fully named ``path`` (manifest possibly missing -> restore-only fallback
+    validation) or fully named ``<path>.prev`` (manifest intact). Returns
+    whether anything was rotated.
+
+    A manifest-LESS outgoing generation (a partial write from an earlier
+    kill) is NEVER rotated over a manifest-valid ``.prev``: that would rmtree
+    the last-known-good ancestor and leave nothing restorable until the new
+    save's manifest commits. The partial is deleted instead and the ancestor
+    stays put. (With no valid ``.prev`` to protect — legacy manifest-less
+    checkpoints, first saves — rotation proceeds as usual: the outgoing
+    generation remains weakly restorable under the ``.prev`` name.)"""
+    path = os.path.abspath(os.fspath(path))
+    if not os.path.isdir(path):
+        return False
+    prev = path + ".prev"
+    # the ancestor counts as protected only when its DATA directory exists
+    # alongside the readable manifest: after a kill between the manifest
+    # rename and the data rename, the manifest sits under the .prev name
+    # while the (complete) data still sits at ``path`` — deleting ``path``
+    # then would destroy the only copy
+    if not _manifest_readable(path) and os.path.isdir(prev) and _manifest_readable(prev):
+        shutil.rmtree(path)
+        if os.path.exists(manifest_path(path)):  # unreadable remnant
+            os.remove(manifest_path(path))
+        return False
+    base = os.path.basename(path)
+    parent = os.path.dirname(path)
+
+    renames = [(manifest_path(path), manifest_path(prev))]
+    for aux in aux_paths:
+        aux = os.path.abspath(os.fspath(aux))
+        name = os.path.basename(aux)
+        if os.path.dirname(aux) == parent and name.startswith(base) and name != base:
+            renames.append((aux, os.path.join(parent, base + ".prev" + name[len(base):])))
+    renames.append((path, prev))  # the data directory moves LAST
+
+    # clear the stale generation first so every rename below is atomic
+    for _, dst in renames:
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        elif os.path.exists(dst):
+            os.remove(dst)
+    for src, dst in renames:
+        if os.path.exists(src):
+            os.replace(src, dst)
+    return True
+
+
+def save_checkpoint_lineage(
+    path: str,
+    state: Any,
+    aux_files: Optional[Dict[str, Any]] = None,
+    step: Optional[int] = None,
+    retry_policy: Optional[RetryPolicy] = None,
+) -> None:
+    """Crash-safe named save: rotate the previous generation to ``.prev``,
+    commit the state (orbax tmp+rename), then the manifest, then the aux JSON
+    sidecars — strictly in that order, so at every kill point the directory
+    holds at least one checkpoint that ``restore_latest_valid`` accepts.
+
+    ``retry_policy`` retries ONLY the idempotent commit stage (state +
+    manifest + sidecars) on transient IO failures. Rotation runs exactly once
+    per save: re-running it on a retry would rmtree the just-rotated,
+    manifest-valid ``.prev`` ancestor and replace it with the unvalidated
+    in-flight generation — destroying the durability the retry exists for."""
+    path = os.path.abspath(os.fspath(path))
+    aux = {os.path.abspath(os.fspath(p)): payload for p, payload in (aux_files or {}).items()}
+    # ONE host materialization feeds both the orbax save and the checksum
+    # pass (host_snapshot is a cheap identity map when the tree is already
+    # numpy, as on the async writer path) — a device tree here would
+    # otherwise pay a second full-model D2H for the manifest alone
+    state = host_snapshot(state)
+    rotate_previous(path, aux_paths=tuple(aux))
+
+    def commit():
+        save_checkpoint(path, state)
+        write_manifest(path, state, step=step)
+        for aux_path, payload in aux.items():
+            atomic_write_json(aux_path, payload)
+
+    if retry_policy is not None:
+        retry_call(commit, policy=retry_policy)
+    else:
+        commit()
+
+
+def restore_latest_valid(
+    directory: str, template: Any, shardings: Optional[Any] = None
+) -> Tuple[Any, Dict]:
+    """Restore the newest VALID checkpoint in ``directory``: candidates with a
+    manifest are tried first (ordered by manifest step, then mtime) and must
+    pass ``verify_checkpoint``; manifest-less candidates (legacy saves, or a
+    kill between data rename and manifest rename) are tried last, newest
+    first, with restore success as the only validation. Returns ``(state,
+    info)`` where info carries name/path/step/validated and the sibling
+    ``<name>_iterator.json`` path when present. Raises
+    ``CheckpointCorruptError`` when nothing in the directory restores."""
+    directory = os.path.abspath(os.fspath(directory))
+    strong, weak = [], []
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if not os.path.isdir(path) or ".orbax-checkpoint-tmp" in name:
+            continue
+        mtime = os.path.getmtime(path)
+        step, manifest_readable = None, False
+        if os.path.exists(manifest_path(path)):
+            try:
+                with open(manifest_path(path)) as f:
+                    step = json.load(f).get("step")
+                manifest_readable = True
+            except (OSError, json.JSONDecodeError):
+                pass  # unreadable manifest: only the sidecar is torn — the
+                # DATA may be fine, so the candidate falls through to the
+                # restore-only (weak) pass instead of being unrestorable
+        if manifest_readable:
+            strong.append((step if isinstance(step, int) else -1, mtime, name, path))
+        else:
+            weak.append((mtime, name, path))
+
+    candidates = [
+        (name, path, step if step >= 0 else None, True)
+        for step, _, name, path in sorted(strong, key=lambda t: (t[0], t[1]), reverse=True)
+    ] + [(name, path, None, False) for _, name, path in sorted(weak, reverse=True)]
+
+    errors = []
+    for name, path, step, validated in candidates:
+        try:
+            # verification and restore deliberately read the bytes twice:
+            # verify_checkpoint must checksum the RAW saved leaves (a
+            # template restore may cast dtypes, which would break the crc
+            # comparison), while restore_checkpoint places directly into the
+            # template's (possibly sharded) layout. The double read happens
+            # only on this rare recovery path.
+            if validated:
+                manifest = verify_checkpoint(path)
+                step = manifest.get("step", step)
+            state = restore_checkpoint(path, template, shardings)
+        except Exception as e:  # noqa: BLE001 — fall back past every broken candidate
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+            continue
+        iterator = path + "_iterator.json"
+        return state, {
+            "name": name,
+            "path": path,
+            "step": step,
+            "validated": "manifest" if validated else "restore-only",
+            "iterator_path": iterator if os.path.exists(iterator) else None,
+            "skipped": errors,
+        }
+    raise CheckpointCorruptError(
+        f"no valid checkpoint in {directory}"
+        + (f" (tried: {'; '.join(errors)})" if errors else " (no candidates)")
+    )
 
 
 def host_snapshot(state: Any) -> Any:
@@ -92,9 +395,14 @@ class AsyncCheckpointWriter:
       * atomicity is unchanged from the sync path: orbax finalizes into the
         destination via tmp + rename, and aux JSON files (the iterator
         snapshot) are written tmp + ``os.replace`` AFTER the state commit, the
-        same order the sync path uses;
-      * writer-thread failures are re-raised on the training thread at the next
-        ``submit``/``wait``/``close`` — never swallowed;
+        same order the sync path uses; lineage submits additionally rotate the
+        previous generation and write the integrity manifest
+        (``save_checkpoint_lineage``) on the writer thread;
+      * transient IO failures (OSError and kin) are retried with bounded
+        backoff (``retry_policy``, reliability/retry.py) before being treated
+        as real; persistent writer-thread failures are re-raised on the
+        training thread at the next ``submit``/``wait``/``close`` — never
+        swallowed;
       * ``close`` drains the outstanding write and joins the (non-daemon)
         thread; the final/best checkpoints stay synchronous and must only be
         written after ``close``/``wait``.
@@ -104,13 +412,14 @@ class AsyncCheckpointWriter:
     (``PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT=1``).
     """
 
-    def __init__(self):
+    def __init__(self, retry_policy: Optional[RetryPolicy] = None):
         self._cond = threading.Condition()
         self._pending: Optional[tuple] = None
         self._busy = False
         self._closed = False
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
+        self._retry = retry_policy or RetryPolicy()
 
     def _raise_pending_error(self) -> None:
         with self._cond:
@@ -118,16 +427,27 @@ class AsyncCheckpointWriter:
         if error is not None:
             raise RuntimeError("async checkpoint write failed") from error
 
-    def submit(self, path: str, state: Any, aux_files: Optional[Dict[str, Any]] = None) -> None:
+    def submit(
+        self,
+        path: str,
+        state: Any,
+        aux_files: Optional[Dict[str, Any]] = None,
+        lineage: bool = False,
+        step: Optional[int] = None,
+    ) -> None:
         """Snapshot ``state`` to host and queue it for serialization to
         ``path``. ``aux_files`` maps absolute paths to JSON-serializable
-        payloads written (tmp+rename) after the state commit."""
+        payloads written (tmp+rename) after the state commit. ``lineage=True``
+        routes the write through ``save_checkpoint_lineage`` (previous
+        generation rotated to ``.prev``, integrity manifest written)."""
         self._raise_pending_error()
         snapshot = host_snapshot(state)
+        if lineage and step is None:
+            step = infer_step(snapshot)
         with self._cond:
             if self._closed:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
-            self._pending = (path, snapshot, dict(aux_files or {}))
+            self._pending = (path, snapshot, dict(aux_files or {}), lineage, step)
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="perceiver-async-ckpt", daemon=False
@@ -142,13 +462,22 @@ class AsyncCheckpointWriter:
                     self._cond.wait()
                 if self._pending is None:  # closed and drained
                     return
-                path, snapshot, aux = self._pending
+                path, snapshot, aux, lineage, step = self._pending
                 self._pending = None
                 self._busy = True
             try:
-                save_checkpoint(path, snapshot)
-                for aux_path, payload in aux.items():
-                    atomic_write_json(aux_path, payload)
+                if lineage:
+                    # the retry policy rides INSIDE the lineage save so only
+                    # its idempotent commit stage is replayed — never the
+                    # rotation (see save_checkpoint_lineage)
+                    save_checkpoint_lineage(
+                        path, snapshot, aux_files=aux, step=step,
+                        retry_policy=self._retry,
+                    )
+                else:
+                    retry_call(save_checkpoint, path, snapshot, policy=self._retry)
+                    for aux_path, payload in aux.items():
+                        atomic_write_json(aux_path, payload)
             except BaseException as e:  # noqa: BLE001 — surfaced on the training thread
                 with self._cond:
                     self._error = e

@@ -29,6 +29,14 @@ Kill-switches restore the fully synchronous pre-overlap paths:
 ``PERCEIVER_IO_TPU_DISABLE_ASYNC_CHECKPOINT`` (env), or
 ``prefetch_depth=0`` / ``async_checkpoint=False`` in TrainerConfig.
 
+Reliability (docs/reliability.md): named checkpoints are LINEAGE saves
+(previous generation rotated to ``.prev`` + integrity manifest —
+``restore_latest_valid`` falls back past a save torn by a preemption
+mid-flush), SIGTERM/SIGINT triggers a once-only graceful stop with a final
+synchronous checkpoint and exact resume, and the ``batch.nan`` fault point
+(inert unless armed) exercises the ``skip_nonfinite_updates`` containment of
+the step factories.
+
 Mesh-parallel: pass ``mesh_axes`` to shard the train state (DP/FSDP/TP per
 parallel/sharding.py) — XLA SPMD handles the collectives.
 """
@@ -37,10 +45,12 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
+import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, Optional
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,11 +64,12 @@ from perceiver_io_tpu.parallel.api import (
     shard_train_state,
 )
 from perceiver_io_tpu.parallel.mesh import make_mesh
+from perceiver_io_tpu.reliability import faults
 from perceiver_io_tpu.training.checkpoint import (
     AsyncCheckpointWriter,
-    atomic_write_json,
     restore_checkpoint,
-    save_checkpoint,
+    restore_latest_valid,
+    save_checkpoint_lineage,
 )
 from perceiver_io_tpu.training.trainer import TrainState
 
@@ -105,6 +116,14 @@ class TrainerConfig:
     profile_dir: Optional[str] = None
     profile_start_step: int = 3
     profile_steps: int = 5
+    # preemption safety (docs/reliability.md): on SIGTERM/SIGINT a once-only
+    # handler requests a graceful stop — the loop exits at the next step
+    # boundary, the async writer drains, the prefetcher joins, and the normal
+    # final synchronous checkpoint (+ iterator snapshot) is taken, so the next
+    # run resumes EXACTLY. A second signal takes the default (forceful) path.
+    # Handlers are only installable from the main thread; elsewhere the knob
+    # is a no-op.
+    handle_preemption: bool = True
 
 
 def _batch_leading_dim(batch) -> int:
@@ -122,9 +141,29 @@ class Trainer:
         self.config = config
         self.log = log_fn
         self.history: list = []
+        self.preempted = False  # True after a fit() stopped on SIGTERM/SIGINT
+        self._preempt_requested = False
         self._metric_fold = None
         self._eval_init = None
         self._eval_fold = None
+
+    def _install_preemption_handler(self) -> Tuple[Callable, dict]:
+        """Install the once-only SIGTERM/SIGINT graceful-stop handler (main
+        thread only — the only place CPython delivers signals). The handler
+        sets a flag the step loop polls at step boundaries AND restores the
+        previous handlers, so a second signal is forceful, not swallowed.
+        Returns (handler, previous-handlers) for symmetric restore."""
+        previous: dict = {}
+
+        def on_preempt(signum, frame):
+            self._preempt_requested = True
+            for s, h in previous.items():
+                signal.signal(s, h)
+
+        if self.config.handle_preemption and threading.current_thread() is threading.main_thread():
+            for s in (signal.SIGTERM, signal.SIGINT):
+                previous[s] = signal.signal(s, on_preempt)
+        return on_preempt, previous
 
     def fit(
         self,
@@ -203,8 +242,11 @@ class Trainer:
 
         profiling = False
         epoch_source = None
+        self._preempt_requested = False
+        self.preempted = False
+        on_preempt, prev_handlers = self._install_preemption_handler()
         try:
-            while step_count < cfg.max_steps:
+            while step_count < cfg.max_steps and not self._preempt_requested:
                 epoch_source = first_source if stateful else wrap(train_loader_fn())
                 self._train_source = epoch_source if stateful else None
                 for batch in epoch_source:
@@ -212,7 +254,9 @@ class Trainer:
                         jax.block_until_ready(state.params)  # trace device work of OUR steps only
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
-                    state, metrics = step_fn(state, loop_put(batch))
+                    # inert pass-through unless the batch.nan fault point is
+                    # armed (reliability/faults.py; chaos and containment tests)
+                    state, metrics = step_fn(state, faults.poison_batch(loop_put(batch)))
                     step_count += 1
                     window_steps += 1
                     acc = metrics if acc is None else self._fold_metrics(acc, metrics)
@@ -241,6 +285,11 @@ class Trainer:
                         window_t0, window_steps = time.perf_counter(), 0
 
                     if cfg.checkpoint_dir and cfg.checkpoint_every and step_count % cfg.checkpoint_every == 0:
+                        # lineage saves (docs/reliability.md): the previous
+                        # "last" generation rotates to "last.prev" and an
+                        # integrity manifest commits after the state, so a
+                        # kill at any byte of this write leaves a checkpoint
+                        # restore_latest_valid accepts
                         if writer is not None:
                             # host snapshot only — serialization happens on the
                             # writer thread, the step loop continues immediately
@@ -248,10 +297,16 @@ class Trainer:
                                 os.path.join(cfg.checkpoint_dir, "last"),
                                 state,
                                 aux_files=self._iterator_aux("last_iterator.json"),
+                                lineage=True,
+                                step=step_count,
                             )
                         else:
-                            save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
-                            self._save_iterator_state("last_iterator.json")
+                            save_checkpoint_lineage(
+                                os.path.join(cfg.checkpoint_dir, "last"),
+                                state,
+                                aux_files=self._iterator_aux("last_iterator.json"),
+                                step=step_count,
+                            )
                         # checkpoint wall time must not pollute the next
                         # tokens/sec + MFU sample: the sync branch serializes
                         # inline, and even the async submit pays a device sync
@@ -269,11 +324,24 @@ class Trainer:
                         # eval/checkpoint wall time must not pollute throughput telemetry
                         window_t0, window_steps = time.perf_counter(), 0
 
-                    if step_count >= cfg.max_steps:
+                    if step_count >= cfg.max_steps or self._preempt_requested:
+                        # graceful preemption stop: break AFTER the completed
+                        # step and BEFORE the for-statement pulls another batch
+                        # (pulling would advance the loader's resume position
+                        # past a batch that was never trained on). Breaking out
+                        # joins the prefetcher (generator finally), the outer
+                        # finally drains the async writer, and the final
+                        # synchronous checkpoint below persists this exact
+                        # position for exact resume.
                         break
         finally:
+            # hand the signals back first (only where OUR handler is still
+            # installed — the once-only handler swaps itself out on first fire)
+            for s, h in prev_handlers.items():
+                if signal.getsignal(s) is on_preempt:
+                    signal.signal(s, h)
             # threads must ALWAYS join — normal completion, max_steps break,
-            # and exceptions anywhere in the loop alike
+            # preemption, and exceptions anywhere in the loop alike
             for src in (epoch_source, first_source):
                 if isinstance(src, DevicePrefetcher):
                     src.shutdown()
@@ -292,9 +360,18 @@ class Trainer:
 
         if profiling:  # max_steps inside the profile window
             jax.profiler.stop_trace()
+        self.preempted = self._preempt_requested
+        if self.preempted:
+            self.log(json.dumps({"step": step_count, "preempted": True}))
         if cfg.checkpoint_dir:
-            save_checkpoint(os.path.join(cfg.checkpoint_dir, "last"), state)
-            self._save_iterator_state("last_iterator.json")
+            # the final SYNCHRONOUS save — after a preemption this is the
+            # checkpoint the next run resumes from exactly
+            save_checkpoint_lineage(
+                os.path.join(cfg.checkpoint_dir, "last"),
+                state,
+                aux_files=self._iterator_aux("last_iterator.json"),
+                step=step_count,
+            )
         return state
 
     def _fold_metrics(self, acc, metrics):
@@ -307,26 +384,18 @@ class Trainer:
         return self._metric_fold(acc, metrics)
 
     def _iterator_aux(self, filename: str) -> Optional[Dict]:
-        """Iterator snapshot paired with an async state write: captured NOW
-        (synchronously, so it matches the state snapshot), serialized later by
-        the writer thread."""
+        """Iterator-snapshot sidecar for a lineage save: the train loader's
+        exact position (epoch RNG + consumed batches; under prefetch, the last
+        batch the STEP LOOP consumed, not the worker's read-ahead —
+        data/prefetch.py) captured NOW, synchronously, so it matches the state
+        snapshot — serialized later (tmp+rename, after the state commit) by
+        whichever thread performs the write. Enables resume on precisely the
+        next unseen batch, a recovery guarantee the reference's Lightning
+        restarts do not make."""
         src = getattr(self, "_train_source", None)
         if not self.config.checkpoint_dir or src is None or not hasattr(src, "state_dict"):
             return None
         return {os.path.join(self.config.checkpoint_dir, filename): src.state_dict()}
-
-    def _save_iterator_state(self, filename: str) -> None:
-        """Persist the train loader's exact position (epoch RNG + consumed
-        batches) next to the checkpoint, when the loader supports it — enables
-        resume on precisely the next unseen batch (data/loader.py; under
-        prefetch the position is the last batch the STEP LOOP consumed, not the
-        worker's read-ahead — data/prefetch.py), a recovery guarantee the
-        reference's Lightning restarts do not make."""
-        src = getattr(self, "_train_source", None)
-        if not self.config.checkpoint_dir or src is None or not hasattr(src, "state_dict"):
-            return
-        # atomic: a preemption mid-write cannot corrupt the snapshot
-        atomic_write_json(os.path.join(self.config.checkpoint_dir, filename), src.state_dict())
 
     @staticmethod
     def restore_iterator(path: str, loader) -> None:
@@ -385,14 +454,21 @@ class Trainer:
                 # in-flight periodic write must finish first: orbax checkpoint
                 # dirs must not be written concurrently from two threads
                 writer.wait()
-            save_checkpoint(os.path.join(cfg.checkpoint_dir, "best"), state)
-            # keep the iterator snapshot in lockstep with the weights it pairs with
-            self._save_iterator_state("best_iterator.json")
-            # persist the monitor value so a resumed run keeps competing
-            # against this best instead of overwriting it unconditionally
-            atomic_write_json(
-                os.path.join(cfg.checkpoint_dir, "best_metric.json"),
-                {"monitor": cfg.monitor, "value": float(value)},
+            # lineage save: the iterator snapshot stays in lockstep with the
+            # weights it pairs with, and the monitor value is persisted so a
+            # resumed run keeps competing against this best instead of
+            # overwriting it unconditionally
+            save_checkpoint_lineage(
+                os.path.join(cfg.checkpoint_dir, "best"),
+                state,
+                aux_files={
+                    **(self._iterator_aux("best_iterator.json") or {}),
+                    os.path.join(cfg.checkpoint_dir, "best_metric.json"): {
+                        "monitor": cfg.monitor,
+                        "value": float(value),
+                    },
+                },
+                step=int(state.step),
             )
             self.log(json.dumps({"checkpoint": "best", cfg.monitor: round(value, 5)}))
             return value
@@ -401,3 +477,13 @@ class Trainer:
     @staticmethod
     def restore(path: str, state_template: TrainState) -> TrainState:
         return restore_checkpoint(path, state_template)
+
+    @staticmethod
+    def restore_latest_valid(directory: str, state_template: TrainState):
+        """Restore the newest checkpoint in ``directory`` that passes
+        integrity validation, falling back past corrupt/partial ones (e.g.
+        a ``last`` torn by a preemption mid-flush falls back to
+        ``last.prev`` or ``best``). Returns ``(state, info)``; ``info``
+        carries the restored name/step and the matching iterator-snapshot
+        path when one exists (see training/checkpoint.py)."""
+        return restore_latest_valid(directory, state_template)

@@ -81,7 +81,47 @@ def _apply_updates(state: TrainState, tx, grads) -> TrainState:
     return state.replace(step=state.step + 1, params=params, opt_state=opt_state)
 
 
-def make_classifier_train_step(model, tx: optax.GradientTransformation, input_key: str = "image", label_key: str = "label"):
+def _guarded_apply_updates(state: TrainState, tx, grads, loss):
+    """``skip_nonfinite_updates`` path: detect a non-finite loss or gradient
+    norm ON DEVICE and skip the optimizer update for that step — params and
+    optimizer state keep their pre-step values (one poisoned batch cannot
+    destroy a run), while ``step`` still advances so the dropout-RNG fold-in
+    stream is unchanged. Returns ``(new_state, ok)`` with ``ok`` a device
+    scalar (no host sync; the fit loop folds it into the window metrics).
+    When everything is finite this is BITWISE identical to ``_apply_updates``:
+    ``where(True, new, old)`` selects ``new`` exactly (f64-pinned by test)."""
+    gnorm = optax.global_norm(grads)
+    ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+    # zero the grads when skipping so the optimizer arithmetic below stays
+    # finite (NaN * 0 would still be NaN inside the masked-out update)
+    safe = jax.tree.map(lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
+    updates, opt_state = tx.update(safe, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+    return (
+        state.replace(
+            step=state.step + 1,
+            params=jax.tree.map(keep, params, state.params),
+            opt_state=jax.tree.map(keep, opt_state, state.opt_state),
+        ),
+        ok,
+    )
+
+
+def _finalize_step(state: TrainState, tx, grads, loss, metrics, skip_nonfinite: bool):
+    """Shared tail of every train step: apply (or guard) the update. With the
+    guard on, metrics gain ``skipped_nonfinite`` (0/1 per step; the trainer's
+    window logging reports its MEAN — the skipped fraction of the window)."""
+    if not skip_nonfinite:
+        return _apply_updates(state, tx, grads), metrics
+    new_state, ok = _guarded_apply_updates(state, tx, grads, loss)
+    return new_state, {**metrics, "skipped_nonfinite": (~ok).astype(jnp.float32)}
+
+
+def make_classifier_train_step(
+    model, tx: optax.GradientTransformation, input_key: str = "image", label_key: str = "label",
+    skip_nonfinite_updates: bool = False,
+):
     """Training step for classification tasks (image or text), mirroring
     LitClassifier.step (reference core/lightning.py:48-77)."""
 
@@ -93,7 +133,7 @@ def make_classifier_train_step(model, tx: optax.GradientTransformation, input_ke
             return classification_loss_and_metrics(logits, batch[label_key])
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        return _apply_updates(state, tx, grads), metrics
+        return _finalize_step(state, tx, grads, loss, metrics, skip_nonfinite_updates)
 
     return train_step
 
@@ -108,7 +148,7 @@ def make_classifier_eval_step(model, input_key: str = "image", label_key: str = 
     return eval_step
 
 
-def make_mlm_train_step(model, tx: optax.GradientTransformation):
+def make_mlm_train_step(model, tx: optax.GradientTransformation, skip_nonfinite_updates: bool = False):
     """Masked-LM step: CE over positions whose label != -100
     (reference text/mlm/lightning.py:51-72)."""
 
@@ -121,12 +161,14 @@ def make_mlm_train_step(model, tx: optax.GradientTransformation):
             return loss, {"loss": loss}
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        return _apply_updates(state, tx, grads), metrics
+        return _finalize_step(state, tx, grads, loss, metrics, skip_nonfinite_updates)
 
     return train_step
 
 
-def make_causal_lm_train_step(model, tx: optax.GradientTransformation, max_latents: int):
+def make_causal_lm_train_step(
+    model, tx: optax.GradientTransformation, max_latents: int, skip_nonfinite_updates: bool = False
+):
     """Causal-LM step, mirroring LitCausalSequenceModel.step (reference
     core/lightning.py:117-133): pad labels -> -100, prefix_len = seq_len -
     max_latents (static), CE over the latent logits only."""
@@ -153,7 +195,7 @@ def make_causal_lm_train_step(model, tx: optax.GradientTransformation, max_laten
             return loss, {"loss": loss}
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        return _apply_updates(state, tx, grads), metrics
+        return _finalize_step(state, tx, grads, loss, metrics, skip_nonfinite_updates)
 
     return train_step
 

@@ -1,0 +1,388 @@
+"""Chaos smoke driver: arm each fault point, run a short fit + serve loop on
+CPU, and assert the recovery invariants of docs/reliability.md.
+
+This is the executable form of the reliability contract — CI runs it (via the
+fast-tier pytest smoke in tests/test_reliability.py) so the failure paths are
+exercised on every change, not just when production finds them:
+
+  * ``no_fault_inert``     nothing armed: every request FINISHED, no reliability
+                           counter moves, and a repeat run is token-identical
+                           (the harness itself perturbs nothing)
+  * ``flaky_loader``       transient fetch failures are absorbed by the retry
+                           policy; training completes with finite loss
+  * ``slow_loader``        injected fetch stalls land on the worker thread;
+                           training completes
+  * ``nan_batch_skip``     a NaN-poisoned batch is skipped by
+                           ``skip_nonfinite_updates`` (params stay finite,
+                           the skip is counted); the UNguarded arm proves the
+                           poison is real (params go NaN)
+  * ``checkpoint_kill``    a kill mid-flush of the newest checkpoint falls
+                           back to the rotated previous generation
+  * ``checkpoint_corrupt`` a torn write of the newest checkpoint fails
+                           manifest validation and falls back
+  * ``serving_deadline``   an injected tick stall expires a deadlined request
+                           (TIMED_OUT); its slot-mate's tokens are identical
+                           to a fault-free run
+  * ``serving_nan``        poisoned logits evict exactly the poisoned slot
+                           (FAILED); the survivor's tokens are identical to an
+                           unpoisoned run
+  * ``queue_bound``        submits past ``max_queue_depth`` are REJECTED with
+                           backpressure counters; ``drain()`` finishes active
+                           slots and refuses new work
+
+Every scenario is deterministic: fault firing is counter-based (no clocks, no
+randomness — reliability/faults.py), model/workload seeds are fixed, so a
+failure here reproduces exactly.
+
+Usage: ``JAX_PLATFORMS=cpu python scripts/chaos_check.py [--checks a,b] [--out CHAOS_CHECK.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from perceiver_io_tpu.reliability import armed
+from perceiver_io_tpu.reliability.faults import FAULTS, KilledMidWrite
+
+
+# --------------------------------------------------------------- tiny fixtures
+
+
+def _serving_setup():
+    """One tiny CausalSequenceModel shared by every serving check."""
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+    config = CausalSequenceModelConfig(
+        vocab_size=60, max_seq_len=12, max_latents=6, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config)
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        rng, jax.random.randint(rng, (1, 8), 0, 60), prefix_len=2
+    )
+    return model, params
+
+
+def _engine(model, params, **kwargs):
+    from perceiver_io_tpu.serving import ServingEngine
+
+    return ServingEngine(model, params, **kwargs)
+
+
+def _loader(n=24, batch_size=2, seed=3):
+    from perceiver_io_tpu.data.loader import DataLoader
+
+    rs = np.random.RandomState(seed)
+    examples = [rs.randn(4).astype(np.float32) for _ in range(n)]
+    return DataLoader(
+        examples, batch_size,
+        collate_fn=lambda ex: {"x": np.stack(ex)},
+        shuffle=True, rng=np.random.default_rng(seed),
+    )
+
+
+def _train_setup(skip_nonfinite: bool):
+    """Tiny float-feature regression step (differentiable, poisonable by
+    ``batch.nan``) driven through the REAL Trainer.fit loop."""
+    from perceiver_io_tpu.training.trainer import TrainState, _finalize_step
+
+    tx = optax.sgd(1e-2)
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            loss = jnp.mean((batch["x"] @ p["w"]) ** 2)
+            return loss, {"loss": loss}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return _finalize_step(state, tx, grads, loss, metrics, skip_nonfinite)
+
+    make_state = lambda: TrainState.create({"w": jnp.ones((4,), jnp.float32)}, tx)  # noqa: E731
+    return make_state, train_step
+
+
+def _fit(train_step, make_state, steps=6, **cfg_kwargs):
+    from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
+
+    lines = []
+    trainer = Trainer(
+        TrainerConfig(max_steps=steps, log_every=1, eval_every=10_000,
+                      prefetch_depth=2, **cfg_kwargs),
+        log_fn=lambda line: lines.append(json.loads(line)),
+    )
+    state = trainer.fit(make_state(), train_step, lambda: _loader())
+    return state, lines
+
+
+def _greedy_tokens(engine, prompts, max_new=5, **submit_kwargs):
+    handles = [engine.submit(p, max_new_tokens=max_new, **submit_kwargs) for p in prompts]
+    engine.run_until_drained(max_steps=200)
+    return handles
+
+
+# --------------------------------------------------------------------- checks
+
+
+def check_no_fault_inert() -> dict:
+    """Nothing armed: the reliability layer must be invisible — all requests
+    FINISHED, zero reliability counters, repeat runs token-identical."""
+    model, params = _serving_setup()
+
+    def serve_once():
+        engine = _engine(model, params, num_slots=2, max_queue_depth=8, default_deadline_s=60.0)
+        handles = _greedy_tokens(engine, [[1, 2, 3], [4, 5], [6, 7, 8, 9]])
+        snap = engine.metrics.snapshot()
+        return [h.result().tolist() for h in handles], [h.status.value for h in handles], snap
+
+    toks1, statuses, snap = serve_once()
+    toks2, _, _ = serve_once()
+    make_state, train_step = _train_setup(skip_nonfinite=True)
+    state, lines = _fit(train_step, make_state)
+    losses = [l["loss"] for l in lines if "loss" in l]
+    return {
+        "ok": (
+            toks1 == toks2
+            and all(s == "finished" for s in statuses)
+            and snap["rejected"] == snap["timed_out"] == snap["failed"] == 0
+            and len(losses) == 6
+            and all(np.isfinite(losses))
+            and not FAULTS.armed_points()
+        ),
+        "statuses": statuses,
+        "repeat_identical": toks1 == toks2,
+        "reliability_counters": {k: snap[k] for k in ("rejected", "timed_out", "failed")},
+    }
+
+
+def check_flaky_loader() -> dict:
+    make_state, train_step = _train_setup(skip_nonfinite=False)
+    with armed("loader.fetch.flaky", times=2):
+        state, lines = _fit(train_step, make_state)
+    losses = [l["loss"] for l in lines if "loss" in l]
+    return {"ok": len(losses) == 6 and all(np.isfinite(losses)), "steps": len(losses)}
+
+
+def check_slow_loader() -> dict:
+    make_state, train_step = _train_setup(skip_nonfinite=False)
+    with armed("loader.fetch.slow", times=3, value=0.05):
+        state, lines = _fit(train_step, make_state)
+    losses = [l["loss"] for l in lines if "loss" in l]
+    return {"ok": len(losses) == 6 and all(np.isfinite(losses)), "steps": len(losses)}
+
+
+def check_nan_batch_skip() -> dict:
+    # guarded arm: the poisoned step is skipped, params stay finite
+    make_state, train_step = _train_setup(skip_nonfinite=True)
+    with armed("batch.nan", after=2, times=1):
+        state, lines = _fit(train_step, make_state)
+    skipped = sum(l.get("skipped_nonfinite", 0) for l in lines)
+    guarded_finite = bool(np.isfinite(np.asarray(state.params["w"])).all())
+    # unguarded arm: the same poison must destroy the run — proves injection
+    make_state_u, train_step_u = _train_setup(skip_nonfinite=False)
+    with armed("batch.nan", after=2, times=1):
+        state_u, _ = _fit(train_step_u, make_state_u)
+    unguarded_nan = bool(np.isnan(np.asarray(state_u.params["w"])).any())
+    return {
+        "ok": guarded_finite and skipped == 1 and unguarded_nan,
+        "skipped_nonfinite": skipped,
+        "unguarded_params_went_nan": unguarded_nan,
+    }
+
+
+def check_checkpoint_kill() -> dict:
+    from perceiver_io_tpu.training.checkpoint import restore_latest_valid, save_checkpoint_lineage
+    from perceiver_io_tpu.training.trainer import TrainState
+
+    tx = optax.sgd(1e-2)
+    mk = lambda s: TrainState.create({"w": jnp.arange(4.0) + s}, tx).replace(  # noqa: E731
+        step=jnp.asarray(s, jnp.int32)
+    )
+    d = tempfile.mkdtemp(prefix="chaos-kill-")
+    try:
+        save_checkpoint_lineage(os.path.join(d, "last"), mk(2), step=2)
+        killed = False
+        try:
+            with armed("checkpoint.write.kill"):
+                save_checkpoint_lineage(os.path.join(d, "last"), mk(4), step=4)
+        except KilledMidWrite:
+            killed = True
+        state, info = restore_latest_valid(d, mk(0))
+        return {
+            "ok": killed and int(state.step) == 2 and info["validated"] == "manifest",
+            "restored": info["name"],
+            "restored_step": int(state.step),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def check_checkpoint_corrupt() -> dict:
+    from perceiver_io_tpu.training.checkpoint import restore_latest_valid, save_checkpoint_lineage
+    from perceiver_io_tpu.training.trainer import TrainState
+
+    tx = optax.sgd(1e-2)
+    mk = lambda s: TrainState.create({"w": jnp.arange(4.0) + s}, tx).replace(  # noqa: E731
+        step=jnp.asarray(s, jnp.int32)
+    )
+    d = tempfile.mkdtemp(prefix="chaos-corrupt-")
+    try:
+        save_checkpoint_lineage(os.path.join(d, "last"), mk(2), step=2)
+        with armed("checkpoint.corrupt"):
+            save_checkpoint_lineage(os.path.join(d, "last"), mk(4), step=4)
+        state, info = restore_latest_valid(d, mk(0))
+        return {
+            "ok": int(state.step) == 2 and info["name"] == "last.prev" and bool(info["skipped"]),
+            "restored": info["name"],
+            "skipped": info["skipped"],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def check_serving_deadline() -> dict:
+    model, params = _serving_setup()
+    # fault-free reference for the survivor
+    ref = _greedy_tokens(_engine(model, params, num_slots=2), [[4, 5, 6]])[0]
+    engine = _engine(model, params, num_slots=2)
+    doomed = engine.submit([1, 2, 3], max_new_tokens=50, deadline_s=0.05)
+    survivor = engine.submit([4, 5, 6], max_new_tokens=5)
+    with armed("serving.deadline", times=1, value=0.1):
+        engine.run_until_drained(max_steps=200)
+    snap = engine.metrics.snapshot()
+    return {
+        "ok": (
+            doomed.status.value == "timed_out"
+            and survivor.ok
+            and survivor.result().tolist() == ref.result().tolist()
+            and snap["timed_out"] == 1
+        ),
+        "doomed": doomed.status.value,
+        "survivor_identical": survivor.result().tolist() == ref.result().tolist(),
+    }
+
+
+def check_serving_nan() -> dict:
+    model, params = _serving_setup()
+    ref = _greedy_tokens(_engine(model, params, num_slots=2), [[4, 5, 6]])[0]
+    engine = _engine(model, params, num_slots=2)
+    poisoned = engine.submit([1, 2, 3], max_new_tokens=6)
+    survivor = engine.submit([4, 5, 6], max_new_tokens=5)
+    engine.step()  # both admitted, one token decoded
+    with armed("serving.nan", slot=poisoned.slot):
+        engine.step()
+    engine.run_until_drained(max_steps=100)
+    snap = engine.metrics.snapshot()
+    pool_finite = bool(np.isfinite(np.asarray(engine._state.next_logits)).all())
+    return {
+        "ok": (
+            poisoned.status.value == "failed"
+            and survivor.ok
+            and survivor.result().tolist() == ref.result().tolist()
+            and snap["failed"] == 1
+            and pool_finite
+        ),
+        "poisoned": poisoned.status.value,
+        "survivor_identical": survivor.result().tolist() == ref.result().tolist(),
+        "pool_finite_after_quarantine": pool_finite,
+    }
+
+
+def check_queue_bound() -> dict:
+    model, params = _serving_setup()
+    engine = _engine(model, params, num_slots=1, max_queue_depth=1)
+    running = engine.submit([1, 2], max_new_tokens=4)
+    engine.step()  # occupies the only slot
+    queued = engine.submit([3, 4], max_new_tokens=2)
+    rejected = engine.submit([5, 6], max_new_tokens=2)  # past the bound
+    drained = engine.drain(max_steps=100)
+    post = engine.submit([7, 8], max_new_tokens=2)  # draining engines refuse work
+    snap = engine.metrics.snapshot()
+    return {
+        "ok": (
+            rejected.finish_reason == "queue_full"
+            and running.ok
+            and queued.finish_reason == "draining"
+            and post.finish_reason == "draining"
+            and snap["rejected"] == 3
+            and snap["queue_depth"] == 0
+            and len(drained) == 3  # running + queued-rejected + bound-rejected
+        ),
+        "reasons": [rejected.finish_reason, queued.finish_reason, post.finish_reason],
+        "rejected_count": snap["rejected"],
+    }
+
+
+CHECKS = {
+    "no_fault_inert": check_no_fault_inert,
+    "flaky_loader": check_flaky_loader,
+    "slow_loader": check_slow_loader,
+    "nan_batch_skip": check_nan_batch_skip,
+    "checkpoint_kill": check_checkpoint_kill,
+    "checkpoint_corrupt": check_checkpoint_corrupt,
+    "serving_deadline": check_serving_deadline,
+    "serving_nan": check_serving_nan,
+    "queue_bound": check_queue_bound,
+}
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checks", default=None,
+                    help=f"comma-separated subset of: {','.join(CHECKS)}")
+    ap.add_argument("--out", default=None,
+                    help="optional JSON artifact path (atomic write)")
+    args = ap.parse_args(argv)
+
+    names = list(CHECKS) if args.checks is None else [s.strip() for s in args.checks.split(",")]
+    unknown = [n for n in names if n not in CHECKS]
+    if unknown:
+        raise SystemExit(f"unknown checks {unknown} (known: {sorted(CHECKS)})")
+
+    results = {}
+    for name in names:
+        FAULTS.reset()  # isolation: no arming leaks between scenarios
+        t0 = time.perf_counter()
+        try:
+            results[name] = CHECKS[name]()
+        except Exception as e:  # noqa: BLE001 — a crash IS a failed check
+            results[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        results[name]["seconds"] = round(time.perf_counter() - t0, 3)
+    FAULTS.reset()
+
+    out = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": jax.default_backend(),
+        "all_ok": all(r["ok"] for r in results.values()),
+        "checks": results,
+    }
+    if args.out:
+        from perceiver_io_tpu.training.checkpoint import atomic_write_json
+
+        atomic_write_json(args.out, out, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps(out, indent=1))
+    if not out["all_ok"]:
+        bad = [n for n, r in results.items() if not r["ok"]]
+        print(f"CHAOS CHECK FAILED: {bad}", file=sys.stderr)
+        if __name__ == "__main__":
+            raise SystemExit(1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,13 @@ def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool
         "decode_compilations": engine.decode_compilations,
         "prefill_compilations": engine.prefill_compilations,
         "prefill_buckets": list(engine.prefill_buckets),
+        # admission-control outcomes (serving-metrics/v3, docs/reliability.md):
+        # all zero on this unbounded/undeadlined workload, reported so a
+        # bounded/deadlined bench run surfaces drops next to its throughput
+        "rejected": snap["rejected"],
+        "timed_out": snap["timed_out"],
+        "failed": snap["failed"],
+        "queue_depth": snap["queue_depth"],
         "metrics": snap,
     }
 

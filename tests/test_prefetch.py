@@ -15,7 +15,6 @@ import numpy as np
 import pytest
 
 import perceiver_io_tpu.training.checkpoint as ckpt_mod
-import perceiver_io_tpu.training.fit as fit_mod
 from perceiver_io_tpu.data.loader import DataLoader
 from perceiver_io_tpu.data.prefetch import DevicePrefetcher
 from perceiver_io_tpu.training.checkpoint import AsyncCheckpointWriter
@@ -277,8 +276,9 @@ def test_async_checkpoint_never_blocks_steps(tmp_path, monkeypatch):
         time.sleep(0.6)
         real_save(path, state, **kw)
 
+    # checkpoint.py's save_checkpoint is the single serialization point: both
+    # the writer thread and the synchronous lineage path route through it
     monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
-    monkeypatch.setattr(fit_mod, "save_checkpoint", slow_save)
 
     def run(ckpt_dir, async_on):
         monkeypatch.setenv(DISABLE_ASYNC_CHECKPOINT_ENV, "" if async_on else "1")
@@ -347,7 +347,7 @@ def test_sync_checkpoint_resets_throughput_window(tmp_path, monkeypatch):
         time.sleep(0.5)
         real_save(path, state, **kw)
 
-    monkeypatch.setattr(fit_mod, "save_checkpoint", slow_save)
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
     lines = []
     trainer = Trainer(
         TrainerConfig(max_steps=8, log_every=4, eval_every=10_000, checkpoint_every=2,

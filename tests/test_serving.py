@@ -208,10 +208,13 @@ def test_scheduler_fifo_and_slot_reuse():
 def test_submit_validation(setup):
     model, params = setup
     engine = ServingEngine(model, params, num_slots=1)
-    with pytest.raises(ValueError, match="out of valid range"):
+    with pytest.raises(ValueError, match="non-empty"):
         engine.submit([])
-    with pytest.raises(ValueError, match="out of valid range"):
-        engine.submit(list(range(WINDOW + 1)))
+    # a WELL-FORMED but unservable request is an admission outcome, not a
+    # crash: over-long prompts are rejected at submit (docs/reliability.md)
+    too_long = engine.submit(list(range(WINDOW + 1)), max_new_tokens=2)
+    assert too_long.done and not too_long.ok
+    assert too_long.finish_reason == "prompt_too_long"
     with pytest.raises(ValueError, match="beam"):
         engine.submit([1, 2], config=GenerationConfig(max_new_tokens=2, num_beams=3))
     with pytest.raises(ValueError, match="contrastive"):
@@ -310,7 +313,8 @@ def test_metrics_standalone_counters():
     m.record_decode_step(active_slots=2, seconds=0.2, tokens=2)
     m.record_finish(0, slot=1, new_tokens=1, reason="length")
     snap = m.snapshot()
-    assert snap["schema"] == "serving-metrics/v2"
+    assert snap["schema"] == "serving-metrics/v3"
+    assert snap["rejected"] == snap["timed_out"] == snap["failed"] == 0
     assert snap["mean_slot_occupancy"] == 0.5
     assert snap["tokens_generated"] == 2 and snap["decode_steps"] == 1
     assert snap["queue_wait_s"] == {"mean": 0.5, "max": 0.5, "p50": 0.5, "p95": 0.5}
