@@ -1,0 +1,50 @@
+"""Unified telemetry (docs/observability.md): a jax-free recorder core
+(counters / gauges / span timers with injectable clocks, off-by-default with
+a zero-overhead null recorder), a Chrome-trace exporter viewable in Perfetto,
+a compile watchdog promoting the suite's compile-count pins into a runtime
+signal, and run-manifest provenance for every artifact.
+
+``perceiver_io_tpu.obs.core`` stays importable without jax; importing THIS
+package surface pulls the watchdog (which needs ``jax.monitoring``) — fine
+everywhere telemetry is actually wired (serving engine, training loop).
+"""
+
+from perceiver_io_tpu.obs.core import (
+    NULL_RECORDER,
+    TELEMETRY_ENV,
+    NullRecorder,
+    TelemetryRecorder,
+    resolve_recorder,
+    telemetry_env_setting,
+)
+from perceiver_io_tpu.obs.manifest import (
+    ARTIFACT_SCHEMAS,
+    build_run_manifest,
+    manifest_path_for,
+    write_run_manifest,
+)
+from perceiver_io_tpu.obs.trace import (
+    load_chrome_trace,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from perceiver_io_tpu.obs.watchdog import CompileWatchdog
+
+__all__ = [
+    "ARTIFACT_SCHEMAS",
+    "CompileWatchdog",
+    "NULL_RECORDER",
+    "NullRecorder",
+    "TELEMETRY_ENV",
+    "TelemetryRecorder",
+    "build_run_manifest",
+    "load_chrome_trace",
+    "manifest_path_for",
+    "resolve_recorder",
+    "telemetry_env_setting",
+    "to_chrome_trace",
+    "validate_chrome_trace",
+    "write_chrome_trace",
+    "write_run_manifest",
+]

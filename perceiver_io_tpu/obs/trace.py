@@ -1,0 +1,119 @@
+"""Chrome ``trace_event`` JSON export for the telemetry recorder.
+
+The recorder (obs/core.py) buffers events with SECOND-resolution offsets from
+its construction instant; this module renders them in the Chrome trace-event
+format (the JSON Array/Object format Perfetto and chrome://tracing load
+natively): complete spans ("X", microsecond ``ts``/``dur``), async lifecycle
+spans ("b"/"n"/"e" keyed by id — one per served request), and instant markers
+("i", e.g. an unexpected-recompile flag). The recorder's aggregate summary
+rides in trace ``metadata`` so one artifact carries both the timeline and the
+numbers ``scripts/obs_report.py`` tabulates.
+
+``load_chrome_trace``/``validate_chrome_trace`` are the read side: the
+validator is what tests/test_obs.py pins (parses, non-negative monotonic-safe
+timestamps, balanced async begin/end per id) and what obs_report runs before
+trusting an artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+TRACE_SCHEMA = "chrome-trace/v1"
+
+_S_TO_US = 1e6
+
+
+def to_chrome_trace(events: List[Dict], summary: Optional[Dict] = None,
+                    dropped: int = 0) -> Dict:
+    """Render recorder events (second-resolution offsets) as a Chrome trace
+    dict. ``ts``/``dur`` become integer-safe microsecond floats; everything
+    else passes through."""
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        ev["ts"] = round(ev["ts"] * _S_TO_US, 3)
+        if "dur" in ev:
+            ev["dur"] = round(ev["dur"] * _S_TO_US, 3)
+        ev.setdefault("pid", os.getpid())
+        out.append(ev)
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "schema": TRACE_SCHEMA,
+            **({"summary": summary} if summary else {}),
+            **({"events_dropped": dropped} if dropped else {}),
+        },
+    }
+
+
+def write_chrome_trace(path: str, trace: Dict) -> str:
+    """Atomic write (tmp + rename): a kill mid-flush must not leave a torn
+    artifact the next ``obs_report`` run chokes on."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_chrome_trace(path: str) -> Dict:
+    with open(path) as f:
+        trace = json.load(f)
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        raise ValueError(f"{path} is not a Chrome trace object (no traceEvents)")
+    return trace
+
+
+def validate_chrome_trace(trace: Dict) -> List[str]:
+    """Structural validation; returns a list of problems (empty = valid).
+
+    Checks: every event has a phase and a non-negative numeric ``ts``;
+    complete events carry non-negative ``dur``; async spans are BALANCED —
+    every (cat, id) opened by "b" is closed by exactly one "e" whose ``ts``
+    is not before the begin; timestamps never precede the trace origin (0).
+
+    A trace whose recorder EVICTED old events (bounded buffer;
+    ``metadata.events_dropped`` > 0) legitimately contains async ends/instants
+    whose begins were dropped — those imbalances are tolerated then, so a
+    long-run trace does not read as corrupt when truncation was intentional
+    and counted. Spans left open at export time (requests still in flight)
+    are likewise reported only for untruncated traces.
+    """
+    problems: List[str] = []
+    truncated = bool((trace.get("metadata") or {}).get("events_dropped"))
+    open_async: Dict[tuple, float] = {}
+    for i, ev in enumerate(trace.get("traceEvents", [])):
+        ph = ev.get("ph")
+        ts = ev.get("ts")
+        if ph is None or not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: missing ph/ts ({ev})")
+            continue
+        if ts < 0:
+            problems.append(f"event {i} ({ev.get('name')}): negative ts {ts}")
+        if ph == "X" and not (isinstance(ev.get("dur"), (int, float)) and ev["dur"] >= 0):
+            problems.append(f"event {i} ({ev.get('name')}): bad dur {ev.get('dur')}")
+        elif ph == "b":
+            key = (ev.get("cat"), ev.get("id"))
+            if key in open_async:
+                problems.append(f"event {i}: async span {key} begun twice")
+            open_async[key] = ts
+        elif ph == "e":
+            key = (ev.get("cat"), ev.get("id"))
+            if key not in open_async:
+                if not truncated:
+                    problems.append(f"event {i}: async end {key} without begin")
+            elif ts < open_async.pop(key):
+                problems.append(f"event {i}: async span {key} ends before it begins")
+        elif ph == "n":
+            key = (ev.get("cat"), ev.get("id"))
+            if key not in open_async and not truncated:
+                problems.append(f"event {i}: async instant {key} outside open span")
+    if not truncated:
+        for key in open_async:
+            problems.append(f"async span {key} never ended")
+    return problems

@@ -1,0 +1,210 @@
+"""Compile watchdog: runtime detection of unexpected XLA recompilation.
+
+The repo's compile-count invariants ("churn never recompiles", "<= one
+prefill program per bucket") are pinned by tests, but a production run can
+still recompile silently — a stray weak-type promotion, a new batch shape, a
+donation mismatch — and the only symptom is a latency spike someone has to
+bisect. The watchdog promotes the test pins into a runtime signal:
+
+  * **per-function budgets**: jitted callables are registered with
+    ``watch(name, fn, budget=...)``; their tracing-cache sizes
+    (``fn._cache_size()`` — the number of distinct compiled programs) are
+    polled by ``check()`` at natural tick boundaries (serving tick, train log
+    window). A cache bigger than its budget is a violation.
+  * **steady-state marking**: ``mark_steady()`` freezes the current counts as
+    the expected plateau (warmup compiles are legitimate); ANY growth after
+    it — budgeted or not, including the process-wide backend-compile count —
+    is a violation. This is how the trainer flags a mid-run recompile without
+    having to predict how many programs a model legitimately needs.
+  * **process-wide counting**: one module-level ``jax.monitoring`` duration
+    listener (installed lazily, fan-out to live watchdogs) counts backend
+    compilations and feeds their durations into the attached recorder as the
+    ``jax.compile.backend`` phase, so compile time shows up in the same phase
+    breakdown as everything else.
+
+Violations are deduplicated (a cache that jumped from 1 to 3 is reported
+once, not once per subsequent tick), counted on the recorder
+(``compile.unexpected``), dropped into the trace as instant events, and kept
+on ``watchdog.violations`` for reports. The watchdog never raises: an
+unexpected recompile is a signal, not an error — serving must not fall over
+because telemetry noticed something.
+
+With telemetry disabled no watchdog is constructed and the monitoring
+listener fans out to an empty set: the hot paths stay inert.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, List, Optional
+
+from perceiver_io_tpu.obs.core import NULL_RECORDER
+
+# one process-wide monitoring listener, installed on first watchdog
+# construction. WEAK references: a strong set would pin every watchdog (and
+# its watched jitted programs + recorder buffers) forever when an owner drops
+# one without close() — the set itself would make the __del__ backstop
+# unreachable. Live owners (engine/trainer) hold the strong ref.
+_DISPATCH_LOCK = threading.Lock()
+_LIVE_WATCHDOGS: "weakref.WeakSet[CompileWatchdog]" = weakref.WeakSet()
+_LISTENER_INSTALLED = False
+
+_BACKEND_COMPILE_SUFFIX = "backend_compile_duration"
+
+
+def _dispatch_duration(name: str, duration: float, **kwargs) -> None:
+    if not name.endswith(_BACKEND_COMPILE_SUFFIX):
+        return
+    with _DISPATCH_LOCK:
+        targets = list(_LIVE_WATCHDOGS)
+    for wd in targets:
+        wd._on_backend_compile(duration)
+
+
+def _install_listener() -> None:
+    global _LISTENER_INSTALLED
+    with _DISPATCH_LOCK:
+        if _LISTENER_INSTALLED:
+            return
+        import jax.monitoring
+
+        # jax.monitoring offers registration only (no unregister short of
+        # clear_event_listeners, which would drop OTHER packages' listeners
+        # too) — hence one permanent dispatcher over a mutable live-set
+        jax.monitoring.register_event_duration_secs_listener(_dispatch_duration)
+        _LISTENER_INSTALLED = True
+
+
+def _cache_size(fn) -> Optional[int]:
+    """Number of compiled programs behind a jitted callable, or None when the
+    object does not expose it (non-jit callables are watchable no-ops)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+class CompileWatchdog:
+    """Tracks compile activity for one surface (an engine, a trainer run)."""
+
+    def __init__(self, recorder=NULL_RECORDER, on_violation: Optional[Callable[[Dict], None]] = None):
+        self._recorder = recorder
+        self._on_violation = on_violation
+        self._lock = threading.Lock()
+        self._watched: Dict[str, Dict] = {}  # name -> {fn, budget, reported}
+        self.backend_compiles = 0  # process-wide compiles seen while live
+        self._steady: Optional[Dict[str, int]] = None
+        self._steady_backend: Optional[int] = None
+        self.violations: List[Dict] = []
+        self._closed = False
+        _install_listener()
+        with _DISPATCH_LOCK:
+            _LIVE_WATCHDOGS.add(self)
+
+    # ----------------------------------------------------------------- wiring
+    def _on_backend_compile(self, duration: float) -> None:
+        with self._lock:
+            self.backend_compiles += 1
+        self._recorder.counter_inc("compile.backend_total")
+        self._recorder.observe("jax.compile.backend", duration)
+
+    def watch(self, name: str, fn, budget: Optional[int] = None) -> None:
+        """Register a jitted callable. ``budget`` = max legitimate program
+        count (e.g. 1 for the serving decode step, len(buckets) for prefill);
+        None = unbudgeted, policed only after ``mark_steady()``."""
+        with self._lock:
+            self._watched[name] = {"fn": fn, "budget": budget, "reported": _cache_size(fn) or 0}
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Current per-watch compiled-program counts (None-reporting fns are 0)."""
+        with self._lock:
+            return {name: _cache_size(w["fn"]) or 0 for name, w in self._watched.items()}
+
+    def mark_steady(self) -> None:
+        """Freeze the current counts as the expected plateau: every compile
+        after this point — anywhere in the process — is flagged."""
+        with self._lock:
+            self._steady = {name: _cache_size(w["fn"]) or 0 for name, w in self._watched.items()}
+            self._steady_backend = self.backend_compiles
+
+    # ------------------------------------------------------------------ checks
+    def check(self) -> List[Dict]:
+        """Poll the watched caches; return (and record) NEW violations since
+        the last check. Cheap enough for per-tick use: one int read per watch."""
+        fresh: List[Dict] = []
+        with self._lock:
+            for name, w in self._watched.items():
+                count = _cache_size(w["fn"])
+                if count is None:
+                    continue
+                budget = w["budget"]
+                if budget is not None and count > budget and count > w["reported"]:
+                    fresh.append({
+                        "kind": "budget_exceeded", "function": name,
+                        "compilations": count, "budget": budget,
+                    })
+                    w["reported"] = count
+                if self._steady is not None and count > self._steady.get(name, 0) and count > w["reported"]:
+                    fresh.append({
+                        "kind": "recompile_after_steady", "function": name,
+                        "compilations": count, "steady": self._steady.get(name, 0),
+                    })
+                    w["reported"] = count
+            if (
+                self._steady_backend is not None
+                and self.backend_compiles > self._steady_backend
+            ):
+                fresh.append({
+                    "kind": "backend_compile_after_steady",
+                    "function": "process",
+                    "compilations": self.backend_compiles,
+                    "steady": self._steady_backend,
+                })
+                self._steady_backend = self.backend_compiles  # report the jump once
+            self.violations.extend(fresh)
+        for v in fresh:
+            self._recorder.counter_inc("compile.unexpected")
+            self._recorder.instant("compile.unexpected", **v)
+            if self._on_violation is not None:
+                self._on_violation(v)
+        return fresh
+
+    def summary(self) -> Dict:
+        """Compile-count report for artifacts: per-watch counts + budgets,
+        process-wide backend compiles, and any violations."""
+        counts = self.compile_counts()
+        with self._lock:
+            return {
+                "per_function": {
+                    name: {"compilations": counts[name], "budget": w["budget"]}
+                    for name, w in self._watched.items()
+                },
+                "backend_compiles": self.backend_compiles,
+                "unexpected": list(self.violations),
+            }
+
+    def close(self) -> None:
+        """Detach from the monitoring dispatcher. Idempotent and safe at
+        interpreter shutdown (set discard, no IO)."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        with _DISPATCH_LOCK:
+            _LIVE_WATCHDOGS.discard(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

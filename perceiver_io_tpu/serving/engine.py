@@ -53,6 +53,15 @@ slots run to completion, and further submits are refused. With no deadline
 set, no bound configured, and no fault armed, all of this is bit-inert —
 compile counts and greedy parity are unchanged (pinned).
 
+Telemetry (docs/observability.md): ``ServingEngine(telemetry=...)`` (or the
+``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans per tick (admit /
+prefill dispatch / install / decode dispatch / sample-sync / evict),
+per-request lifecycle spans keyed by request id (joinable against the
+serving-metrics/v3 JSONL events), and a compile watchdog that flags any
+program count growing past the churn-never-recompiles budgets at runtime.
+Off by default; the disabled path holds the shared no-op recorder and the
+greedy-parity and compile-count pins run through it unchanged.
+
 Kill-switches: ``PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL=1`` pins the
 ladder at the single full-window bucket (the PR-1 behavior);
 ``PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1`` disables live-length masking
@@ -81,6 +90,8 @@ import numpy as np
 
 from perceiver_io_tpu.generation.generate import GenerationConfig, _cache_dtype
 from perceiver_io_tpu.generation.sampling import process_logits_batched, sample_token_batched
+from perceiver_io_tpu.obs.core import resolve_recorder
+from perceiver_io_tpu.obs.watchdog import CompileWatchdog
 from perceiver_io_tpu.reliability import faults
 from perceiver_io_tpu.serving.metrics import EngineMetrics
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
@@ -198,6 +209,10 @@ def _engine_compatible(config: GenerationConfig) -> Optional[str]:
     return None
 
 
+# distinguishes concurrent engines' lifecycle spans in a shared recorder
+_ENGINE_IDS = itertools.count()
+
+
 def default_prefill_buckets(window: int, max_latents: int) -> tuple:
     """Geometric (halving) ladder of prefill bucket lengths, from the full
     window down to the smallest bucket that still fits ``max_latents`` latents
@@ -230,6 +245,7 @@ class ServingEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_queue_depth: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
+        telemetry=None,
     ):
         self.model = model
         self.params = params
@@ -237,6 +253,23 @@ class ServingEngine:
         self.cache_dtype = cache_dtype if cache_dtype is not None else _cache_dtype(model)
         self.scheduler: SlotScheduler[ServedRequest] = SlotScheduler(num_slots)
         self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
+        # unified telemetry (docs/observability.md): phase spans per tick,
+        # per-request lifecycle spans keyed by request id (joinable against
+        # the serving-metrics/v3 events carrying the same request_id), and a
+        # compile watchdog policing the churn-never-recompiles invariant at
+        # runtime. Off by default: ``telemetry=None`` defers to the
+        # PERCEIVER_IO_TPU_TELEMETRY env, and the disabled surface is the
+        # shared NULL_RECORDER — instrumented paths stay inert (the f64
+        # parity pins run THROUGH them, recorder on and off).
+        self._obs, self._owns_telemetry = resolve_recorder(telemetry)
+        self._obs_on = self._obs.enabled
+        # per-engine async-span category: request ids restart at 0 per engine,
+        # so two engines sharing one caller-owned recorder would otherwise
+        # collide on (cat, id) and corrupt the trace's lifetime joins
+        self._span_cat = f"request.e{next(_ENGINE_IDS)}"
+        self.watchdog: Optional[CompileWatchdog] = (
+            CompileWatchdog(recorder=self._obs) if self._obs_on else None
+        )
         self.finished: List[ServedRequest] = []
         self._ids = itertools.count()
         self._requests: Dict[int, ServedRequest] = {}
@@ -293,6 +326,20 @@ class ServingEngine:
         # serving); storing them narrower would silently cast at install
         self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
         self._build_jits()
+        if self.watchdog is not None:
+            # the engine's own compile-count pins, as runtime budgets: one
+            # decode/install/release/quarantine program ever, <= one prefill
+            # program per ladder bucket (tests/test_serving.py churn test)
+            self.watchdog.watch("serving.decode_step", self._jit_decode, budget=1)
+            self.watchdog.watch("serving.prefill", self._jit_prefill,
+                                budget=len(self.prefill_buckets))
+            # install consumes the BUCKET-shaped req_cache, so like prefill it
+            # owns one legitimate program per ladder bucket (the churn test's
+            # "<= ladder prefill+install programs" bound)
+            self.watchdog.watch("serving.install", self._jit_install,
+                                budget=len(self.prefill_buckets))
+            self.watchdog.watch("serving.release", self._jit_release, budget=1)
+            self.watchdog.watch("serving.quarantine", self._jit_quarantine, budget=1)
 
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
@@ -466,6 +513,12 @@ class ServingEngine:
         if request.deadline_s is not None:
             self._deadlines_seen = True
         self.metrics.record_submit(request.request_id, int(prompt.size))
+        if self._obs_on:
+            # lifecycle span: submit -> queued -> prefill -> ... -> terminal,
+            # keyed by request id (the join key against serving-metrics events)
+            self._obs.async_begin(self._span_cat, request.request_id,
+                                  prompt_len=int(prompt.size))
+            self._obs.async_instant(self._span_cat, request.request_id, "queued")
         if self._draining:
             return self._reject(request, "draining")
         if prompt.size > self._window:
@@ -494,6 +547,10 @@ class ServingEngine:
         request.finished_at = time.perf_counter()
         self.finished.append(request)
         self.metrics.record_reject(request.request_id, reason)
+        if self._obs_on:
+            self._obs.counter_inc("serving.rejected")
+            self._obs.async_end(self._span_cat, request.request_id,
+                                status="rejected", reason=reason)
         return request
 
     # ------------------------------------------------------------------- admit
@@ -519,21 +576,23 @@ class ServingEngine:
         cfg = request.config
         t0 = time.perf_counter()
         bucket = self._bucket_for(request.prompt_ids.size)
-        ids, pad_mask = self._bucket_prompt(request, bucket)
-        req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
-        self._cache, self._state = self._jit_install(
-            self._cache, self._state, slot, req_cache, req_logits, request.rng,
-            # greedy requests ignore temperature/top_k/top_p (argmax survives
-            # scaling and filtering): install the neutral encodings so any
-            # user value — including temperature <= 0 — shares the one
-            # compiled step, and a greedy slot never keeps the batch-wide
-            # vocab-sort filter branches live (see _jit_release)
-            float(cfg.temperature) if cfg.do_sample else 1.0,
-            int(cfg.top_k) if (cfg.do_sample and cfg.top_k) else 0,
-            float(cfg.top_p) if (cfg.do_sample and cfg.top_p is not None) else 1.0,
-            bool(cfg.do_sample),
-            int(cfg.pad_token_id),
-        )
+        with self._obs.span("serving.prefill_dispatch"):
+            ids, pad_mask = self._bucket_prompt(request, bucket)
+            req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
+        with self._obs.span("serving.install"):
+            self._cache, self._state = self._jit_install(
+                self._cache, self._state, slot, req_cache, req_logits, request.rng,
+                # greedy requests ignore temperature/top_k/top_p (argmax survives
+                # scaling and filtering): install the neutral encodings so any
+                # user value — including temperature <= 0 — shares the one
+                # compiled step, and a greedy slot never keeps the batch-wide
+                # vocab-sort filter branches live (see _jit_release)
+                float(cfg.temperature) if cfg.do_sample else 1.0,
+                int(cfg.top_k) if (cfg.do_sample and cfg.top_k) else 0,
+                float(cfg.top_p) if (cfg.do_sample and cfg.top_p is not None) else 1.0,
+                bool(cfg.do_sample),
+                int(cfg.pad_token_id),
+            )
         # NON-BLOCKING: no device sync here — the prefill/install dispatch
         # overlaps the decode stream, and step() syncs once per tick (its
         # np.asarray on the decoded tokens). prefill_s is therefore dispatch
@@ -546,6 +605,9 @@ class ServingEngine:
             request.request_id, slot, wait_s=now - request.submitted_at,
             prefill_s=now - t0, bucket=bucket,
         )
+        if self._obs_on:
+            self._obs.async_instant(self._span_cat, request.request_id, "prefill",
+                                    slot=slot, bucket=bucket)
 
     def _evict(
         self, slot: int, request: ServedRequest, reason: str,
@@ -563,6 +625,10 @@ class ServingEngine:
             request.request_id, slot, len(request.output_ids), reason,
             status=status.value,
         )
+        if self._obs_on:
+            self._obs.async_end(self._span_cat, request.request_id,
+                                status=status.value, reason=reason,
+                                new_tokens=len(request.output_ids))
 
     # --------------------------------------------------------------- deadlines
     def _expire_deadlines(self, now: float) -> None:
@@ -582,6 +648,10 @@ class ServingEngine:
             request.finished_at = now
             self.finished.append(request)
             self.metrics.record_timeout_queued(request.request_id)
+            if self._obs_on:
+                self._obs.async_end(self._span_cat, request.request_id,
+                                    status="timed_out", reason="deadline",
+                                    new_tokens=0)
         for slot, request in list(self.scheduler.occupied()):
             if request.deadline_at is not None and now >= request.deadline_at:
                 self._evict(slot, request, "deadline", status=RequestStatus.TIMED_OUT)
@@ -610,43 +680,60 @@ class ServingEngine:
         finished (or contained) requests. Returns True while work remains
         (occupied slots or queued requests)."""
         faults.fire_serving_tick_delay()  # injected stall (deadline-overrun chaos)
-        if self._deadlines_seen:
-            self._expire_deadlines(time.perf_counter())
-        if not self._draining:
-            for slot, request in self.scheduler.pop_admissible():
-                self._admit(slot, request)
-        self._maybe_inject_nan()
-        occupied = list(self.scheduler.occupied())
-        if not occupied:
-            return self.scheduler.has_work
+        with self._obs.span("serving.tick"):
+            if self._deadlines_seen:
+                self._expire_deadlines(time.perf_counter())
+            if not self._draining:
+                with self._obs.span("serving.admit"):
+                    for slot, request in self.scheduler.pop_admissible():
+                        self._admit(slot, request)
+            self._maybe_inject_nan()
+            occupied = list(self.scheduler.occupied())
+            if self._obs_on:
+                self._obs.gauge_set("serving.active_slots", len(occupied))
+                self._obs.gauge_set("serving.queue_depth", self.scheduler.queue_depth)
+            if not occupied:
+                return self.scheduler.has_work
 
-        t0 = time.perf_counter()
-        tok, finite, self._cache, self._state = self._jit_decode(
-            self.params, self._cache, self._state
-        )
-        tok = np.asarray(tok)  # blocks: the step's device sync point
-        finite = np.asarray(finite)  # already on host after the sync above
-        decode_s = time.perf_counter() - t0
-        # tokens_generated counts USEFUL tokens only: a quarantined slot's
-        # garbage sample is never emitted, so it must not inflate the count
-        useful = sum(1 for slot, _ in occupied if finite[slot])
-        self.metrics.record_decode_step(len(occupied), decode_s, tokens=useful)
+            t0 = time.perf_counter()
+            with self._obs.span("serving.decode_dispatch"):
+                # dispatch only — the jit call returns before the device step
+                # finishes; the device cost lands in the sample-sync below
+                tok, finite, self._cache, self._state = self._jit_decode(
+                    self.params, self._cache, self._state
+                )
+            with self._obs.span("serving.sample_sync"):
+                tok = np.asarray(tok)  # blocks: the step's ONE device sync point
+                finite = np.asarray(finite)  # already on host after the sync above
+            decode_s = time.perf_counter() - t0
+            # tokens_generated counts USEFUL tokens only: a quarantined slot's
+            # garbage sample is never emitted, so it must not inflate the count
+            useful = sum(1 for slot, _ in occupied if finite[slot])
+            self.metrics.record_decode_step(len(occupied), decode_s, tokens=useful)
 
-        for slot, request in occupied:
-            if not finite[slot]:
-                # containment: the token sampled from non-finite logits is
-                # garbage — never emitted — and the slot's cache/state rows
-                # are zeroed so nothing non-finite survives in the pool
-                self._cache = self._jit_quarantine(self._cache, slot)
-                self._evict(slot, request, "nonfinite_logits", status=RequestStatus.FAILED)
-                continue
-            token = int(tok[slot])
-            request.output_ids.append(token)
-            cfg = request.config
-            if cfg.eos_token_id is not None and token == cfg.eos_token_id:
-                self._evict(slot, request, "eos")
-            elif len(request.output_ids) >= cfg.max_new_tokens:
-                self._evict(slot, request, "length")
+            with self._obs.span("serving.evict"):
+                for slot, request in occupied:
+                    if not finite[slot]:
+                        # containment: the token sampled from non-finite logits
+                        # is garbage — never emitted — and the slot's
+                        # cache/state rows are zeroed so nothing non-finite
+                        # survives in the pool
+                        self._cache = self._jit_quarantine(self._cache, slot)
+                        self._evict(slot, request, "nonfinite_logits",
+                                    status=RequestStatus.FAILED)
+                        continue
+                    token = int(tok[slot])
+                    request.output_ids.append(token)
+                    cfg = request.config
+                    if cfg.eos_token_id is not None and token == cfg.eos_token_id:
+                        self._evict(slot, request, "eos")
+                    elif len(request.output_ids) >= cfg.max_new_tokens:
+                        self._evict(slot, request, "length")
+            if self.watchdog is not None:
+                # per-tick budget poll: one int read per watched program — any
+                # growth past the churn-never-recompiles budgets is flagged
+                # (counter compile.unexpected + instant trace event), never raised
+                self.watchdog.check()
         return self.scheduler.has_work
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> List[ServedRequest]:
@@ -671,3 +758,33 @@ class ServingEngine:
         for request in self.scheduler.prune_queue(lambda r: True):
             self._reject(request, "draining")
         return self.run_until_drained(max_steps=max_steps)
+
+    # --------------------------------------------------------------- telemetry
+    @property
+    def telemetry(self):
+        """The engine's recorder (the shared no-op recorder when disabled).
+        Read-only: the recorder is bound at construction, together with the
+        watchdog and the enabled gate."""
+        return self._obs
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """Phase breakdown + compile report when telemetry is on, else None —
+        the block ``serve_bench --profile`` embeds (docs/observability.md)."""
+        if not self._obs_on:
+            return None
+        out = self._obs.summary()
+        if self.watchdog is not None:
+            out["compile"] = self.watchdog.summary()
+        return out
+
+    def close(self) -> None:
+        """Release observability resources: the metrics JSONL handle, the
+        compile watchdog's monitoring hook, and — when the engine created its
+        recorder from a knob/env rather than being handed one — the recorder
+        itself (which writes its Chrome trace if a path was configured).
+        Idempotent; caller-owned recorders are left open."""
+        self.metrics.close()
+        if self.watchdog is not None:
+            self.watchdog.close()
+        if self._owns_telemetry:
+            self._obs.close()

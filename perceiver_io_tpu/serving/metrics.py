@@ -139,10 +139,14 @@ class EngineMetrics:
     _prefill_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _decode_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _jsonl_file: Optional[object] = field(default=None, repr=False)
+    _closed: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------------ events
     def _emit(self, event: str, **fields) -> None:
-        if self.jsonl_path is None:
+        if self.jsonl_path is None or self._closed:
+            # a closed metrics object silently drops events instead of
+            # resurrecting its handle: close() is a real end-of-life, and an
+            # _emit racing interpreter teardown must not call open()
             return
         if self._jsonl_file is None:
             # one line-buffered handle for the engine's lifetime: _emit runs
@@ -250,11 +254,22 @@ class EngineMetrics:
         return snap
 
     def close(self) -> None:
-        """Release the JSONL handle (call before replacing or discarding a
-        metrics object mid-process; safe to call repeatedly)."""
-        if self._jsonl_file is not None:
-            self._jsonl_file.close()
-            self._jsonl_file = None
+        """Release the JSONL handle. Terminal and idempotent: a second close
+        is a no-op, and later ``_emit`` calls are dropped instead of
+        resurrecting the handle. Guarded against interpreter-shutdown races —
+        ``getattr`` with a True default means a close racing module teardown
+        (``__del__`` during finalization, partially torn-down instance) bails
+        out instead of raising."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        f = self._jsonl_file
+        self._jsonl_file = None
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass  # a handle torn down by interpreter exit is already closed
 
     def __del__(self):  # best-effort backstop; close() is the real contract
         try:

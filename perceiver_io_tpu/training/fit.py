@@ -37,6 +37,17 @@ synchronous checkpoint and exact resume, and the ``batch.nan`` fault point
 (inert unless armed) exercises the ``skip_nonfinite_updates`` containment of
 the step factories.
 
+Observability (docs/observability.md): ``TrainerConfig.telemetry`` (or the
+``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans — fetch-wait (the
+prefetch-starvation / host-bound-attribution signal), step dispatch,
+log-boundary sync, checkpoint submit/drain — plus a compile watchdog that
+marks steady state at the first log boundary (deferred past the first eval
+when eval is configured) and flags any later recompile.
+Off by default and bit-inert (f64 loss-trajectory parity pinned recorder-on
+vs -off). Log lines additionally stream to a versioned ``train-metrics/v1``
+JSONL (``TrainerConfig.metrics_jsonl``), flushed per line so a preemption
+cannot strand history; the default ``log_fn`` print is line-flushed too.
+
 Mesh-parallel: pass ``mesh_axes`` to shard the train state (DP/FSDP/TP per
 parallel/sharding.py) — XLA SPMD handles the collectives.
 """
@@ -46,7 +57,6 @@ from __future__ import annotations
 import json
 import os
 import signal
-import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -56,6 +66,8 @@ import jax
 import jax.numpy as jnp
 
 from perceiver_io_tpu.data.prefetch import DevicePrefetcher
+from perceiver_io_tpu.obs.core import resolve_recorder
+from perceiver_io_tpu.obs.watchdog import CompileWatchdog
 from perceiver_io_tpu.parallel.api import (
     create_sharded_state,
     make_batch_put,
@@ -71,6 +83,7 @@ from perceiver_io_tpu.training.checkpoint import (
     restore_latest_valid,
     save_checkpoint_lineage,
 )
+from perceiver_io_tpu.training.metrics import make_writer
 from perceiver_io_tpu.training.trainer import TrainState
 
 DISABLE_PREFETCH_ENV = "PERCEIVER_IO_TPU_DISABLE_PREFETCH"
@@ -124,6 +137,19 @@ class TrainerConfig:
     # Handlers are only installable from the main thread; elsewhere the knob
     # is a no-op.
     handle_preemption: bool = True
+    # unified telemetry (docs/observability.md): phase spans for fetch-wait
+    # (the prefetch-starvation / host-bound attribution), step dispatch,
+    # log-boundary sync, and checkpoint submit/drain, plus a compile watchdog
+    # flagging mid-run recompiles. None = consult PERCEIVER_IO_TPU_TELEMETRY;
+    # False = off unconditionally; True = in-memory recorder; a path string =
+    # recorder + Chrome trace written there when fit returns; or pass a
+    # TelemetryRecorder you own. Off by default and bit-inert: the f64
+    # loss-trajectory parity pin runs recorder-on vs recorder-off.
+    telemetry: object = None
+    # versioned metric stream (train-metrics/v1, training/metrics.py): every
+    # log line fit emits is ALSO appended here as a schema-stamped JSONL
+    # record, flushed per line so a SIGTERM preemption cannot strand history.
+    metrics_jsonl: Optional[str] = None
 
 
 def _batch_leading_dim(batch) -> int:
@@ -136,16 +162,41 @@ def _batch_leading_dim(batch) -> int:
     return 1
 
 
+def _print_flush(line: str) -> None:
+    """Default log sink: line-flushed print, so a SIGTERM preemption (or a
+    crash) cannot strand the tail of the run's log in a stdout block buffer —
+    the log survives exactly as far as the last completed step boundary."""
+    print(line, flush=True)
+
+
 class Trainer:
-    def __init__(self, config: TrainerConfig, log_fn: Callable[[str], None] = print):
+    def __init__(self, config: TrainerConfig, log_fn: Callable[[str], None] = _print_flush):
         self.config = config
         self.log = log_fn
         self.history: list = []
         self.preempted = False  # True after a fit() stopped on SIGTERM/SIGINT
+        self.telemetry = None  # the recorder of the LAST fit() with telemetry on
+        self.telemetry_summary: Optional[Dict] = None  # its final summary (+compile)
         self._preempt_requested = False
         self._metric_fold = None
         self._eval_init = None
         self._eval_fold = None
+        # versioned metric stream: shared across fit() calls on this trainer
+        # (a resume appends to the same file); closed by close() or GC
+        self._metrics_writer = make_writer(config.metrics_jsonl)
+
+    def _emit(self, kind: str, line: Dict) -> None:
+        """One log record, fanned to both sinks: the train-metrics/v1 JSONL
+        stream (schema-stamped, per-line flushed) and ``log_fn`` (the legacy
+        print-JSON surface tests and CLIs consume, unchanged)."""
+        if self._metrics_writer is not None:
+            self._metrics_writer.write(kind, line)
+        self.log(json.dumps(line))
+
+    def close(self) -> None:
+        """Release the metrics-JSONL handle (idempotent; GC backstops it)."""
+        if self._metrics_writer is not None:
+            self._metrics_writer.close()
 
     def _install_preemption_handler(self) -> Tuple[Callable, dict]:
         """Install the once-only SIGTERM/SIGINT graceful-stop handler (main
@@ -204,6 +255,40 @@ class Trainer:
             eval_fn = jax.jit(eval_step) if eval_step else None
             put = lambda b: b
 
+        # telemetry (docs/observability.md): resolved per fit; owned recorders
+        # (created from True/path/env) are closed — and their trace written —
+        # when this fit ends, caller-passed recorders stay open. The compile
+        # watchdog marks steady state at the FIRST log boundary (everything up
+        # to it is legitimate warmup) and is polled at every later one.
+        obs, owns_obs = resolve_recorder(cfg.telemetry)
+        obs_on = obs.enabled
+        watchdog = CompileWatchdog(recorder=obs) if obs_on else None
+        if watchdog is not None:
+            watchdog.watch("train.step", step_fn)
+            if eval_fn is not None:
+                watchdog.watch("train.eval", eval_fn)
+        self.telemetry = obs if obs_on else None
+        self.telemetry_summary = None
+        self._steady_marked = False
+        evaled_once = False  # steady-marking gate: see the log-boundary check
+        obs_closed = False
+
+        def close_obs():
+            # idempotent: runs on the success path after the final checkpoint
+            # (so the trace includes it) AND from the finally when fit unwinds
+            nonlocal obs_closed
+            if obs_closed:
+                return
+            obs_closed = True
+            if watchdog is not None:
+                watchdog.check()
+                self.telemetry_summary = {**obs.summary(), "compile": watchdog.summary()}
+                watchdog.close()
+            elif obs_on:
+                self.telemetry_summary = obs.summary()
+            if owns_obs:
+                obs.close()
+
         prefetch_on = cfg.prefetch_depth > 0 and not _env_disabled(DISABLE_PREFETCH_ENV)
         async_ckpt_on = (
             cfg.async_checkpoint
@@ -227,6 +312,7 @@ class Trainer:
         best = initial_best
         step_count = int(state.step)
         window_t0, window_steps = time.perf_counter(), 0
+        fetch_wait_window = 0.0  # fetch-wait seconds in the current log window
         # device-side metric accumulation: the window's sums live on device and
         # are transferred ONCE per log boundary (acc_steps is the divisor; it is
         # separate from window_steps, which eval/checkpoint boundaries reset to
@@ -244,19 +330,41 @@ class Trainer:
         epoch_source = None
         self._preempt_requested = False
         self.preempted = False
+        # explicit success flag: sys.exc_info() in the finally cannot tell
+        # "fit is unwinding" from "fit was CALLED inside an active except
+        # handler" — it reports the caller's in-flight exception either way
+        fit_ok = False
         on_preempt, prev_handlers = self._install_preemption_handler()
         try:
             while step_count < cfg.max_steps and not self._preempt_requested:
                 epoch_source = first_source if stateful else wrap(train_loader_fn())
                 self._train_source = epoch_source if stateful else None
-                for batch in epoch_source:
+                epoch_iter = iter(epoch_source)
+                while True:
+                    # fetch-wait: host time blocked on the input pipeline. Under
+                    # prefetch this is the STARVATION signal — near zero when
+                    # the workers keep up, ~the host collate cost when the run
+                    # is input-bound (the BENCH_train_pipeline attribution,
+                    # now visible at runtime instead of only in the bench A/B).
+                    t_fetch = time.perf_counter() if obs_on else 0.0
+                    try:
+                        batch = next(epoch_iter)
+                    except StopIteration:
+                        break
+                    if obs_on:
+                        wait_s = time.perf_counter() - t_fetch
+                        fetch_wait_window += wait_s
+                        obs.observe("train.fetch_wait", wait_s)
                     if cfg.profile_dir and step_count == cfg.profile_start_step and not profiling:
                         jax.block_until_ready(state.params)  # trace device work of OUR steps only
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
                     # inert pass-through unless the batch.nan fault point is
                     # armed (reliability/faults.py; chaos and containment tests)
-                    state, metrics = step_fn(state, faults.poison_batch(loop_put(batch)))
+                    with obs.span("train.step_dispatch"):
+                        # dispatch time only: the jitted step is asynchronous,
+                        # device cost lands in the log-boundary sync
+                        state, metrics = step_fn(state, faults.poison_batch(loop_put(batch)))
                     step_count += 1
                     window_steps += 1
                     acc = metrics if acc is None else self._fold_metrics(acc, metrics)
@@ -266,11 +374,15 @@ class Trainer:
                         jax.block_until_ready(acc["loss"])
                         jax.profiler.stop_trace()
                         profiling = False
-                        self.log(json.dumps({"step": step_count, "profile_trace": cfg.profile_dir}))
-                        window_t0, window_steps = time.perf_counter(), 0  # exclude trace IO
+                        self._emit("profile", {"step": step_count, "profile_trace": cfg.profile_dir})
+                        # exclude trace IO; fetch_wait resets with window_t0 so
+                        # the starvation gauge's numerator and denominator
+                        # always cover the same interval
+                        window_t0, window_steps, fetch_wait_window = time.perf_counter(), 0, 0.0
 
                     if step_count % cfg.log_every == 0:
-                        sums = jax.device_get(acc)  # the window's ONE host sync
+                        with obs.span("train.log_sync"):
+                            sums = jax.device_get(acc)  # the window's ONE host sync
                         means = {k: float(v) / acc_steps for k, v in sums.items()}
                         acc, acc_steps = None, 0
                         dt = time.perf_counter() - window_t0
@@ -280,8 +392,28 @@ class Trainer:
                             line["tokens_per_sec"] = round(tps, 1)
                             if cfg.flops_per_step and cfg.peak_flops:
                                 line["mfu"] = round(cfg.flops_per_step * window_steps / dt / cfg.peak_flops, 4)
+                        if obs_on:
+                            # prefetch-starvation gauge: the fraction of this
+                            # window's wall the step loop spent waiting on
+                            # input — the host-bound attribution at runtime
+                            obs.gauge_set("train.fetch_wait_frac",
+                                          round(fetch_wait_window / dt, 4) if dt > 0 else 0.0)
+                            fetch_wait_window = 0.0
+                            if watchdog is not None:
+                                if self._steady_marked:
+                                    watchdog.check()
+                                elif eval_fn is None or evaled_once:
+                                    # everything compiled before the first log
+                                    # boundary is warmup — but with eval
+                                    # configured, steady also waits for the
+                                    # first eval pass: eval_fn and the eval
+                                    # fold jits legitimately compile then
+                                    # (eval_every > log_every must not flag a
+                                    # healthy run's first eval as a recompile)
+                                    watchdog.mark_steady()
+                                    self._steady_marked = True
                         self.history.append(line)
-                        self.log(json.dumps(line))
+                        self._emit("train_log", line)
                         window_t0, window_steps = time.perf_counter(), 0
 
                     if cfg.checkpoint_dir and cfg.checkpoint_every and step_count % cfg.checkpoint_every == 0:
@@ -290,39 +422,47 @@ class Trainer:
                         # integrity manifest commits after the state, so a
                         # kill at any byte of this write leaves a checkpoint
                         # restore_latest_valid accepts
-                        if writer is not None:
-                            # host snapshot only — serialization happens on the
-                            # writer thread, the step loop continues immediately
-                            writer.submit(
-                                os.path.join(cfg.checkpoint_dir, "last"),
-                                state,
-                                aux_files=self._iterator_aux("last_iterator.json"),
-                                lineage=True,
-                                step=step_count,
-                            )
-                        else:
-                            save_checkpoint_lineage(
-                                os.path.join(cfg.checkpoint_dir, "last"),
-                                state,
-                                aux_files=self._iterator_aux("last_iterator.json"),
-                                step=step_count,
-                            )
+                        with obs.span("train.ckpt_submit", step=step_count,
+                                      mode="async" if writer is not None else "sync"):
+                            if writer is not None:
+                                # host snapshot only — serialization happens on
+                                # the writer thread, the step loop continues
+                                # immediately; the span bounds the snapshot's
+                                # device sync + D2H copy
+                                writer.submit(
+                                    os.path.join(cfg.checkpoint_dir, "last"),
+                                    state,
+                                    aux_files=self._iterator_aux("last_iterator.json"),
+                                    lineage=True,
+                                    step=step_count,
+                                )
+                            else:
+                                save_checkpoint_lineage(
+                                    os.path.join(cfg.checkpoint_dir, "last"),
+                                    state,
+                                    aux_files=self._iterator_aux("last_iterator.json"),
+                                    step=step_count,
+                                )
                         # checkpoint wall time must not pollute the next
                         # tokens/sec + MFU sample: the sync branch serializes
                         # inline, and even the async submit pays a device sync
-                        # + full-state D2H copy (seconds at large model scale)
-                        window_t0, window_steps = time.perf_counter(), 0
+                        # + full-state D2H copy (seconds at large model scale).
+                        # fetch_wait resets in lockstep (gauge interval match).
+                        window_t0, window_steps, fetch_wait_window = time.perf_counter(), 0, 0.0
 
                     if eval_fn is not None and step_count % cfg.eval_every == 0:
-                        val = self.evaluate(state, eval_fn, eval_loader_fn(), put)
+                        with obs.span("train.eval", step=step_count):
+                            val = self.evaluate(state, eval_fn, eval_loader_fn(), put)
+                        evaled_once = True
                         line = {"step": step_count, **{f"val_{k}": round(float(v), 5) for k, v in val.items()}}
                         self.history.append(line)
-                        self.log(json.dumps(line))
+                        self._emit("val", line)
                         if on_eval is not None:
                             on_eval(state, val)
                         best = self._maybe_checkpoint(state, val, best, writer)
-                        # eval/checkpoint wall time must not pollute throughput telemetry
-                        window_t0, window_steps = time.perf_counter(), 0
+                        # eval/checkpoint wall time must not pollute throughput
+                        # telemetry; fetch_wait resets in lockstep with window_t0
+                        window_t0, window_steps, fetch_wait_window = time.perf_counter(), 0, 0.0
 
                     if step_count >= cfg.max_steps or self._preempt_requested:
                         # graceful preemption stop: break AFTER the completed
@@ -334,6 +474,7 @@ class Trainer:
                         # synchronous checkpoint below persists this exact
                         # position for exact resume.
                         break
+            fit_ok = True
         finally:
             # hand the signals back first (only where OUR handler is still
             # installed — the once-only handler swaps itself out on first fire)
@@ -346,32 +487,47 @@ class Trainer:
                 if isinstance(src, DevicePrefetcher):
                     src.shutdown()
             if writer is not None:
-                # captured BEFORE close(): inside an except handler the
-                # just-caught exception is what exc_info reports, which would
-                # make a suppression guard there unconditionally true
-                fit_unwinding = sys.exc_info()[0] is not None
+                # the explicit flag, not sys.exc_info(): inside an except
+                # handler (ours or the CALLER's) the in-flight exception is
+                # what exc_info reports, which would make a suppression guard
+                # here unconditionally true
+                fit_unwinding = not fit_ok
                 try:
                     # drains the outstanding write; the final synchronous save
                     # below must not race a background write to the same path
-                    writer.close()
+                    with obs.span("train.ckpt_drain"):
+                        writer.close()
                 except Exception:
                     if not fit_unwinding:
-                        raise  # surface writer failures when fit itself succeeded
+                        # surface writer failures when fit itself succeeded —
+                        # but this raise skips the success-path close_obs(),
+                        # so release the recorder/watchdog first
+                        close_obs()
+                        raise
+            if not fit_ok:
+                close_obs()  # fit is unwinding: the success path below never runs
 
-        if profiling:  # max_steps inside the profile window
-            jax.profiler.stop_trace()
-        self.preempted = self._preempt_requested
-        if self.preempted:
-            self.log(json.dumps({"step": step_count, "preempted": True}))
-        if cfg.checkpoint_dir:
-            # the final SYNCHRONOUS save — after a preemption this is the
-            # checkpoint the next run resumes from exactly
-            save_checkpoint_lineage(
-                os.path.join(cfg.checkpoint_dir, "last"),
-                state,
-                aux_files=self._iterator_aux("last_iterator.json"),
-                step=step_count,
-            )
+        try:
+            if profiling:  # max_steps inside the profile window
+                jax.profiler.stop_trace()
+            self.preempted = self._preempt_requested
+            if self.preempted:
+                self._emit("preempted", {"step": step_count, "preempted": True})
+            if cfg.checkpoint_dir:
+                # the final SYNCHRONOUS save — after a preemption this is the
+                # checkpoint the next run resumes from exactly
+                with obs.span("train.ckpt_submit", step=step_count, mode="final"):
+                    save_checkpoint_lineage(
+                        os.path.join(cfg.checkpoint_dir, "last"),
+                        state,
+                        aux_files=self._iterator_aux("last_iterator.json"),
+                        step=step_count,
+                    )
+        finally:
+            # runs whether this tail succeeds or raises (a failed final save
+            # is exactly the run you want the trace from); idempotent, so the
+            # unwinding branch of the loop's finally having run it is fine
+            close_obs()
         return state
 
     def _fold_metrics(self, acc, metrics):
@@ -470,7 +626,7 @@ class Trainer:
                 },
                 step=int(state.step),
             )
-            self.log(json.dumps({"checkpoint": "best", cfg.monitor: round(value, 5)}))
+            self._emit("checkpoint", {"checkpoint": "best", cfg.monitor: round(value, 5)})
             return value
         return best
 

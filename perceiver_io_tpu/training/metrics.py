@@ -1,0 +1,143 @@
+"""Versioned training-metrics bus: a JSONL writer + version-tolerant reader.
+
+``Trainer.fit`` used to emit its telemetry as ad-hoc ``print``-JSON lines —
+parseable only by whoever remembered the incidental key set, and lost to a
+SIGTERM that landed while stdout was block-buffered. This module gives the
+training side the same contract the serving side has had since
+``serving-metrics/v1`` (serving/metrics.py):
+
+  * every record is one JSON line stamped ``schema: train-metrics/v1`` with
+    an ``event`` kind (``train_log`` window means + throughput, ``val`` eval
+    results, ``checkpoint``, ``profile``, ``preempted``) and a wall-clock
+    ``ts``;
+  * the writer flushes PER LINE (line-buffered handle + explicit flush), so
+    a preempted run's log is complete up to the final step boundary — the
+    same durability posture as the lineage checkpoints the lines describe;
+  * ``load_metrics_jsonl`` mirrors ``serving/metrics.py:load_metrics_jsonl``:
+    known schemas normalize, schema-less lines are accepted as legacy v0
+    print-records (the pre-versioned format this module replaces), unknown
+    schema strings raise — corrupt/foreign files fail loudly, missing fields
+    of known versions do not.
+
+The writer is jax-free and double-close/interpreter-shutdown safe (same
+guards as ``EngineMetrics``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+SCHEMA = "train-metrics/v1"
+KNOWN_SCHEMAS = ("train-metrics/v1",)
+
+EVENT_KINDS = ("train_log", "val", "checkpoint", "profile", "preempted")
+
+
+class TrainMetricsWriter:
+    """Append-only JSONL writer for one training run's metric stream."""
+
+    def __init__(self, jsonl_path: str):
+        self.jsonl_path = jsonl_path
+        self._file = None
+        self._closed = False
+
+    def write(self, event: str, record: Dict) -> Dict:
+        """Stamp and append one record; returns the full line dict. Flushed
+        per line so a SIGTERM preemption cannot strand buffered history."""
+        if self._closed:
+            return record
+        if self._file is None:
+            self._file = open(self.jsonl_path, "a", buffering=1)
+        line = {"schema": SCHEMA, "event": event, "ts": round(time.time(), 6), **record}
+        self._file.write(json.dumps(line) + "\n")
+        self._file.flush()
+        return line
+
+    def close(self) -> None:
+        """Idempotent; guarded against interpreter-shutdown races (a close
+        racing module teardown is a no-op, not an AttributeError)."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        f = self._file
+        self._file = None
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass
+
+    def __del__(self):  # best-effort backstop; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def load_metrics_jsonl(path: str) -> Dict:
+    """Version-tolerant reader. Returns ``{"events": [...], "by_kind": {...}}``
+    where every event is normalized with ``schema`` and ``event`` keys:
+    schema-less lines (the pre-v1 print-JSON format) become
+    ``schema: None`` with their kind inferred (``val`` if any ``val_*`` key,
+    ``train_log`` if a ``step`` key, else ``other``). Unknown schema strings
+    raise ``ValueError``."""
+    events: List[Dict] = []
+    by_kind: Dict[str, List[Dict]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            record = json.loads(line)
+            schema = record.get("schema")
+            if schema is not None and schema not in KNOWN_SCHEMAS:
+                raise ValueError(f"unknown train-metrics schema {schema!r} in {path}")
+            if schema is None:
+                record = {"schema": None, "event": _legacy_kind(record), **record}
+            events.append(record)
+            by_kind.setdefault(record["event"], []).append(record)
+    return {"events": events, "by_kind": by_kind}
+
+
+def _legacy_kind(record: Dict) -> str:
+    if any(k.startswith("val_") for k in record):
+        return "val"
+    if "checkpoint" in record:
+        return "checkpoint"
+    if "profile_trace" in record:
+        return "profile"
+    if "preempted" in record:
+        return "preempted"
+    if "step" in record:
+        return "train_log"
+    return "other"
+
+
+def summarize(events: List[Dict]) -> Dict:
+    """Small aggregate over a loaded stream (obs_report's training table):
+    step range, window count, last loss, and throughput stats when present."""
+    logs = [e for e in events if e.get("event") == "train_log"]
+    out: Dict = {"train_log_windows": len(logs)}
+    if logs:
+        out["first_step"] = logs[0].get("step")
+        out["last_step"] = logs[-1].get("step")
+        if "loss" in logs[-1]:
+            out["last_loss"] = logs[-1]["loss"]
+        tps = [e["tokens_per_sec"] for e in logs if "tokens_per_sec" in e]
+        if tps:
+            out["tokens_per_sec"] = {
+                "best": max(tps),
+                "last": tps[-1],
+            }
+    vals = [e for e in events if e.get("event") == "val"]
+    if vals:
+        out["evals"] = len(vals)
+        out["last_val"] = {k: v for k, v in vals[-1].items()
+                           if k.startswith("val_") or k == "step"}
+    return out
+
+
+def make_writer(jsonl_path: Optional[str]) -> Optional[TrainMetricsWriter]:
+    return TrainMetricsWriter(jsonl_path) if jsonl_path else None

@@ -371,10 +371,12 @@ def main(argv=None) -> dict:
         "checks": results,
     }
     if args.out:
+        from perceiver_io_tpu.obs import write_run_manifest
         from perceiver_io_tpu.training.checkpoint import atomic_write_json
 
         atomic_write_json(args.out, out, indent=1)
-        print(f"wrote {args.out}", file=sys.stderr)
+        manifest = write_run_manifest(args.out, config=vars(args))
+        print(f"wrote {args.out} (+ {manifest})", file=sys.stderr)
     print(json.dumps(out, indent=1))
     if not out["all_ok"]:
         bad = [n for n, r in results.items() if not r["ok"]]

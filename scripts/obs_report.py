@@ -1,0 +1,238 @@
+"""Telemetry report: phase breakdown tables from observability artifacts.
+
+Reads any mix of the stack's observability outputs and prints the attribution
+the Gemma-serving and Ragged-Paged-Attention comparisons are built on — where
+did the time actually go, per phase:
+
+  * ``--trace``           Chrome trace written by a TelemetryRecorder
+                          (serving engine / Trainer.fit / bench --trace); the
+                          recorder's aggregate summary rides in its metadata.
+  * ``--bench``           a BENCH_*.json whose ``telemetry`` block was
+                          attached by ``serve_bench --profile`` /
+                          ``train_bench --profile``.
+  * ``--serving-metrics`` a serving-metrics JSONL event log (any schema
+                          version serving/metrics.py reads).
+  * ``--train-metrics``   a train-metrics JSONL stream (training/metrics.py).
+
+Output: one phase table per source (count / total / mean / p50 / p95 / max /
+share of accounted time), the counter+gauge dump, the compile-watchdog
+report (per-function compile counts vs budgets, unexpected recompiles —
+LOUD when nonzero), and per-stream summaries for the metrics logs. ``--json``
+emits the same as one machine-readable object. Validation runs before
+trusting a trace (obs/trace.py); problems are reported, not swallowed.
+
+CPU-friendly and jax-free: this script only reads JSON artifacts, so it runs
+anywhere the files are (tests/test_obs.py smoke-runs it end-to-end on a tiny
+engine + fit run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from perceiver_io_tpu.obs.trace import load_chrome_trace, validate_chrome_trace  # noqa: E402
+
+
+def phase_table(phases: Dict[str, Dict], title: str) -> List[str]:
+    """Render one summary['phases'] dict as an aligned text table."""
+    lines = [title, "-" * len(title)]
+    if not phases:
+        lines.append("(no phases recorded)")
+        return lines
+    total_known = sum(p.get("total_s", 0.0) for p in phases.values())
+    header = f"{'phase':<28} {'count':>7} {'total_s':>9} {'mean_ms':>9} {'p50_ms':>8} {'p95_ms':>8} {'max_ms':>8} {'share':>6}"
+    lines.append(header)
+    for name, p in sorted(phases.items(), key=lambda kv: -kv[1].get("total_s", 0.0)):
+        share = p.get("total_s", 0.0) / total_known if total_known > 0 else 0.0
+        lines.append(
+            f"{name:<28} {p.get('count', 0):>7} {p.get('total_s', 0.0):>9.4f} "
+            f"{p.get('mean_s', 0.0) * 1e3:>9.3f} {p.get('p50_s', 0.0) * 1e3:>8.3f} "
+            f"{p.get('p95_s', 0.0) * 1e3:>8.3f} {p.get('max_s', 0.0) * 1e3:>8.3f} "
+            f"{share:>6.1%}"
+        )
+    return lines
+
+
+def compile_report(compile_block: Dict) -> List[str]:
+    lines = ["compile watchdog", "----------------"]
+    per_fn = compile_block.get("per_function", {})
+    for name, info in sorted(per_fn.items()):
+        budget = info.get("budget")
+        lines.append(
+            f"{name:<28} {info.get('compilations', 0):>3} compiled"
+            + (f"  (budget {budget})" if budget is not None else "")
+        )
+    lines.append(f"{'backend compiles (process)':<28} {compile_block.get('backend_compiles', 0):>3}")
+    unexpected = compile_block.get("unexpected", [])
+    if unexpected:
+        lines.append(f"!! {len(unexpected)} UNEXPECTED compile event(s):")
+        for v in unexpected:
+            lines.append(f"   - {json.dumps(v)}")
+    else:
+        lines.append("no unexpected recompiles")
+    return lines
+
+
+def summarize_trace_events(trace: Dict) -> Dict:
+    """Fallback aggregation from raw complete events, for traces whose
+    metadata carries no summary (foreign or truncated artifacts)."""
+    phases: Dict[str, Dict] = {}
+    for ev in trace.get("traceEvents", []):
+        # tolerate malformed events: the validator reports them, the
+        # aggregation must not crash on them
+        if ev.get("ph") != "X" or not isinstance(ev.get("dur"), (int, float)):
+            continue
+        sec = ev["dur"] / 1e6
+        p = phases.setdefault(ev.get("name", "?"), {"count": 0, "total_s": 0.0, "max_s": 0.0, "_durs": []})
+        p["count"] += 1
+        p["total_s"] += sec
+        p["max_s"] = max(p["max_s"], sec)
+        p["_durs"].append(sec)
+    for p in phases.values():
+        durs = sorted(p.pop("_durs"))
+        p["mean_s"] = p["total_s"] / p["count"]
+        p["p50_s"] = durs[len(durs) // 2]
+        p["p95_s"] = durs[min(int(len(durs) * 0.95), len(durs) - 1)]
+        p["total_s"] = round(p["total_s"], 6)
+    return phases
+
+
+def report_trace(path: str) -> Dict:
+    trace = load_chrome_trace(path)
+    problems = validate_chrome_trace(trace)
+    meta = trace.get("metadata", {})
+    summary = meta.get("summary") or {}
+    phases = summary.get("phases") or summarize_trace_events(trace)
+    out = {
+        "source": path,
+        "events": len(trace.get("traceEvents", [])),
+        "phases": phases,
+        "counters": summary.get("counters", {}),
+        "gauges": summary.get("gauges", {}),
+        "validation_problems": problems,
+    }
+    # request-lifecycle stats from async spans (serving traces); events with
+    # no numeric ts are skipped — the validator already reported them
+    begins = {(e.get("cat"), e.get("id")): e["ts"] for e in trace.get("traceEvents", [])
+              if e.get("ph") == "b" and isinstance(e.get("ts"), (int, float))}
+    lifetimes = [
+        (e["ts"] - begins[(e.get("cat"), e.get("id"))]) / 1e6
+        for e in trace.get("traceEvents", [])
+        if e.get("ph") == "e" and isinstance(e.get("ts"), (int, float))
+        and (e.get("cat"), e.get("id")) in begins
+    ]
+    if lifetimes:
+        lifetimes.sort()
+        out["request_lifetimes_s"] = {
+            "count": len(lifetimes),
+            "p50": round(lifetimes[len(lifetimes) // 2], 6),
+            "max": round(lifetimes[-1], 6),
+        }
+    return out
+
+
+def report_bench(path: str) -> Dict:
+    with open(path) as f:
+        bench = json.load(f)
+    telemetry = bench.get("telemetry") or (bench.get("engine") or {}).get("telemetry")
+    if telemetry is None:
+        return {"source": path, "error": "no telemetry block (run the bench with --profile)"}
+    return {"source": path, **telemetry}
+
+
+def report_serving_metrics(path: str) -> Dict:
+    from perceiver_io_tpu.serving.metrics import load_metrics_jsonl
+
+    loaded = load_metrics_jsonl(path)
+    out: Dict = {"source": path, "events": len(loaded["events"])}
+    if loaded["snapshots"]:
+        snap = loaded["snapshots"][-1]
+        out["last_snapshot"] = {
+            k: snap.get(k)
+            for k in ("schema", "requests_submitted", "requests_finished", "rejected",
+                      "timed_out", "failed", "tokens_generated", "decode_tokens_per_s",
+                      "wall_tokens_per_s", "mean_slot_occupancy")
+        }
+    return out
+
+
+def report_train_metrics(path: str) -> Dict:
+    from perceiver_io_tpu.training.metrics import load_metrics_jsonl, summarize
+
+    loaded = load_metrics_jsonl(path)
+    return {"source": path, "events": len(loaded["events"]),
+            **summarize(loaded["events"])}
+
+
+def main(argv=None) -> Dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", action="append", default=[],
+                    help="Chrome trace JSON written by a TelemetryRecorder")
+    ap.add_argument("--bench", action="append", default=[],
+                    help="BENCH_*.json with an embedded telemetry block")
+    ap.add_argument("--serving-metrics", action="append", default=[],
+                    help="serving-metrics JSONL event log")
+    ap.add_argument("--train-metrics", action="append", default=[],
+                    help="train-metrics JSONL stream")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if not (args.trace or args.bench or args.serving_metrics or args.train_metrics):
+        ap.error("nothing to report: pass at least one artifact "
+                 "(--trace/--bench/--serving-metrics/--train-metrics)")
+
+    report: Dict = {"traces": [], "benches": [], "serving_metrics": [], "train_metrics": []}
+    for path in args.trace:
+        report["traces"].append(report_trace(path))
+    for path in args.bench:
+        report["benches"].append(report_bench(path))
+    for path in args.serving_metrics:
+        report["serving_metrics"].append(report_serving_metrics(path))
+    for path in args.train_metrics:
+        report["train_metrics"].append(report_train_metrics(path))
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+        return report
+
+    for section in report["traces"] + report["benches"]:
+        src = section.get("source", "?")
+        if "error" in section:
+            print(f"\n== {src}: {section['error']}")
+            continue
+        print()
+        for line in phase_table(section.get("phases", {}), f"phase breakdown — {src}"):
+            print(line)
+        if section.get("counters") or section.get("gauges"):
+            print("counters:", json.dumps(section.get("counters", {})))
+            print("gauges:  ", json.dumps(section.get("gauges", {})))
+        if section.get("compile"):
+            print()
+            for line in compile_report(section["compile"]):
+                print(line)
+        if section.get("request_lifetimes_s"):
+            print("request lifetimes:", json.dumps(section["request_lifetimes_s"]))
+        problems = section.get("validation_problems")
+        if problems:
+            print(f"!! trace validation problems ({len(problems)}):")
+            for p in problems[:10]:
+                print("   -", p)
+    for section in report["serving_metrics"]:
+        print(f"\nserving metrics — {section['source']}: {section['events']} events")
+        if "last_snapshot" in section:
+            print(json.dumps(section["last_snapshot"], indent=1))
+    for section in report["train_metrics"]:
+        print(f"\ntrain metrics — {section['source']}:")
+        print(json.dumps({k: v for k, v in section.items() if k != "source"}, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    main()

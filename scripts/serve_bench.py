@@ -90,10 +90,14 @@ def synth_workload(config, num_requests: int, seed: int):
     return requests
 
 
-def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool):
+def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool,
+               trace_path=None):
     from perceiver_io_tpu.serving import ServingEngine
 
-    engine = ServingEngine(model, params, num_slots=num_slots, metrics_jsonl=jsonl_path)
+    # False (not None) when no --trace: the ambient env must not switch
+    # recording on inside this TIMED flow (same discipline as the A/B arms)
+    engine = ServingEngine(model, params, num_slots=num_slots, metrics_jsonl=jsonl_path,
+                           telemetry=trace_path if trace_path else False)
     if warmup:
         # one admission + one decode step compiles all three programs
         h = engine.submit(requests[0]["prompt"], max_new_tokens=1)
@@ -120,7 +124,7 @@ def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool
     snap = engine.metrics.write_snapshot()
     new_tokens = sum(len(h.output_ids) for h in engine.finished)
     prompt_tokens = sum(len(r["prompt"]) for r in requests)
-    return {
+    result = {
         "wall_seconds": round(wall, 4),
         "new_tokens": new_tokens,
         "tokens_per_s": round(new_tokens / wall, 2) if wall > 0 else 0.0,
@@ -143,6 +147,13 @@ def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool
         "queue_depth": snap["queue_depth"],
         "metrics": snap,
     }
+    telemetry = engine.telemetry_summary()
+    if telemetry is not None:
+        # per-phase tick breakdown + compile counts (docs/observability.md);
+        # close() writes the Chrome trace when --trace gave a path
+        result["telemetry"] = telemetry
+    engine.close()
+    return result
 
 
 def run_baseline(model, params, requests, warmup: bool):
@@ -202,7 +213,10 @@ def _admission_engine(model, params, prompts, buckets):
     vocab), ready for back-to-back admission timing."""
     from perceiver_io_tpu.serving import ServingEngine
 
-    engine = ServingEngine(model, params, num_slots=len(prompts), prefill_buckets=buckets)
+    # telemetry=False, not None: an ambient PERCEIVER_IO_TPU_TELEMETRY must
+    # not switch recording on inside a TIMED arm and distort the A/B numbers
+    engine = ServingEngine(model, params, num_slots=len(prompts), prefill_buckets=buckets,
+                           telemetry=False)
     for b in sorted({engine._bucket_for(len(p)) for p in prompts}):
         engine.submit([1] * b, max_new_tokens=1)
     for slot, req in engine.scheduler.pop_admissible():
@@ -248,7 +262,9 @@ def _run_decode_arm(model, params, prompts, num_slots: int, buckets, decode_toke
     insensitive to arm ordering)."""
     from perceiver_io_tpu.serving import ServingEngine
 
-    engine = ServingEngine(model, params, num_slots=num_slots, prefill_buckets=buckets)
+    # telemetry=False: same timed-arm discipline as _admission_engine
+    engine = ServingEngine(model, params, num_slots=num_slots, prefill_buckets=buckets,
+                           telemetry=False)
     for i, p in enumerate(prompts):  # first drain warms prefill+decode programs
         engine.submit(p, max_new_tokens=1, rng=jax.random.PRNGKey(i))
     engine.run_until_drained()
@@ -328,7 +344,25 @@ def run_profile(model, config, num_slots: int, num_requests: int, seed: int,
             "fullwindow_baseline": fullwin,
             "admission_speedup": speedup,
         }
+    # telemetry pass (docs/observability.md): one drain of the short workload
+    # on a telemetry-enabled engine — per-phase tick breakdown (admit /
+    # prefill dispatch / install / decode dispatch / sample-sync / evict) and
+    # runtime compile counts land in the artifact. Separate from the timed
+    # arms above so recording overhead never touches the A/B numbers.
+    out["telemetry"] = _telemetry_pass(model, params, workloads["short"], num_slots)
     return out
+
+
+def _telemetry_pass(model, params, prompts, num_slots: int, decode_tokens: int = 8) -> dict:
+    from perceiver_io_tpu.serving import ServingEngine
+
+    engine = ServingEngine(model, params, num_slots=num_slots, telemetry=True)
+    for i, p in enumerate(prompts):
+        engine.submit(p, max_new_tokens=decode_tokens, rng=jax.random.PRNGKey(i))
+    engine.run_until_drained()
+    summary = engine.telemetry_summary()
+    engine.close()
+    return summary
 
 
 def main(argv=None) -> dict:
@@ -348,7 +382,12 @@ def main(argv=None) -> dict:
                     help="run the bucketed-vs-fullwindow prefill A/B on short "
                          "and full-window workloads; writes --profile-out")
     ap.add_argument("--profile-out", default=os.path.join(_REPO, "BENCH_serving.json"))
+    ap.add_argument("--trace", default=None,
+                    help="enable engine telemetry on the main workload and write "
+                         "a Chrome trace (Perfetto-viewable) to this path")
     args = ap.parse_args(argv)
+
+    from perceiver_io_tpu.obs import write_run_manifest
 
     if args.profile:
         model, config = build_model(args.preset)
@@ -363,8 +402,9 @@ def main(argv=None) -> dict:
             json.dump(result, f, indent=1)
             f.write("\n")
         os.replace(tmp, args.profile_out)
+        manifest = write_run_manifest(args.profile_out, config=vars(args))
         print(json.dumps(result))
-        print(f"wrote {args.profile_out}", file=sys.stderr)
+        print(f"wrote {args.profile_out} (+ {manifest})", file=sys.stderr)
         return result
 
     model, config = build_model(args.preset)
@@ -376,7 +416,8 @@ def main(argv=None) -> dict:
     requests = synth_workload(config, args.requests, args.seed)
 
     engine_res = run_engine(model, params, requests, args.slots,
-                            args.metrics_jsonl, warmup=not args.no_warmup)
+                            args.metrics_jsonl, warmup=not args.no_warmup,
+                            trace_path=args.trace)
     result = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": jax.default_backend(),
@@ -402,8 +443,9 @@ def main(argv=None) -> dict:
         json.dump(result, f, indent=1)
         f.write("\n")
     os.replace(tmp, args.out)
+    manifest = write_run_manifest(args.out, config=vars(args))
     print(json.dumps(result))
-    print(f"wrote {args.out}", file=sys.stderr)
+    print(f"wrote {args.out} (+ {manifest})", file=sys.stderr)
     return result
 
 

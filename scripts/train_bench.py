@@ -117,9 +117,12 @@ def calibrate_device_step(model, config, host_params, tx, batch_size: int, probe
 
 
 def run_arm(model, config, host_params, tx, *, overlapped: bool, steps: int, window: int,
-            batch_size: int, host_seconds: float, prefetch_depth: int, seed: int) -> dict:
+            batch_size: int, host_seconds: float, prefetch_depth: int, seed: int,
+            telemetry=False) -> dict:
     """One fit through the production Trainer; steady-state steps/sec is the
-    best post-compile window's tokens_per_sec (tokens_per_batch=1)."""
+    best post-compile window's tokens_per_sec (tokens_per_batch=1).
+    ``telemetry=True`` runs the fit with the unified recorder on and attaches
+    its phase breakdown + compile report (docs/observability.md)."""
     from perceiver_io_tpu.training.fit import Trainer, TrainerConfig
     from perceiver_io_tpu.training.trainer import TrainState, make_causal_lm_train_step
 
@@ -130,6 +133,7 @@ def run_arm(model, config, host_params, tx, *, overlapped: bool, steps: int, win
         tokens_per_batch=1,  # tokens/sec telemetry == steps/sec
         prefetch_depth=prefetch_depth if overlapped else 0,
         async_checkpoint=overlapped,
+        telemetry=telemetry,
     )
     lines = []
     trainer = Trainer(cfg, log_fn=lambda line: lines.append(json.loads(line)))
@@ -140,11 +144,14 @@ def run_arm(model, config, host_params, tx, *, overlapped: bool, steps: int, win
     if len(windows) < 2:
         raise SystemExit(f"need >= 2 telemetry windows, got {windows} (raise --steps)")
     steady = max(windows[1:])  # window 1 absorbs compile
-    return {
+    out = {
         "steps_per_s": steady,
         "windows_steps_per_s": windows,
         "host_s_per_batch_measured": round(loader.host_time_total / max(loader.batches_produced, 1), 5),
     }
+    if trainer.telemetry_summary is not None:
+        out["telemetry"] = trainer.telemetry_summary
+    return out
 
 
 def run_profile(model, config, host_params, tx, args) -> dict:
@@ -165,7 +172,14 @@ def run_profile(model, config, host_params, tx, args) -> dict:
               file=sys.stderr)
     best_overlap = max(r["steps_per_s"] for r in overlapped_runs)
     best_sync = max(r["steps_per_s"] for r in synchronous_runs)
+    # telemetry pass (docs/observability.md): ONE extra overlapped fit with
+    # the recorder on — fetch-wait / step-dispatch / log-sync / checkpoint
+    # phase breakdown plus runtime compile counts, kept out of the timed A/B
+    # arms so recording overhead never touches the speedup numbers
+    telemetry_arm = run_arm(model, config, host_params, tx, overlapped=True,
+                            telemetry=True, **common)
     return {
+        "telemetry": telemetry_arm.get("telemetry"),
         "model": {
             "window": config.max_seq_len, "max_latents": config.max_latents,
             "num_channels": config.num_channels,
@@ -250,12 +264,14 @@ def main(argv=None) -> dict:
         }
         out_path = args.out
 
+    from perceiver_io_tpu.obs import write_run_manifest
     from perceiver_io_tpu.training.checkpoint import atomic_write_json
 
     # atomic: a kill mid-write must not corrupt the artifact
     atomic_write_json(out_path, result, indent=1)
+    manifest = write_run_manifest(out_path, config=vars(args))
     print(json.dumps(result))
-    print(f"wrote {out_path}", file=sys.stderr)
+    print(f"wrote {out_path} (+ {manifest})", file=sys.stderr)
     return result
 
 

@@ -194,7 +194,12 @@ def pytest_collection_modifyitems(config, items):
 
 # Thread prefixes that are process-wide caches/pools, not per-test leaks:
 # concurrent.futures keeps idle workers alive after an executor is collected,
-# and orbax/tensorstore park IO threads between checkpoints. OUR threads
+# and orbax/tensorstore park IO threads between checkpoints. The telemetry
+# flush thread (obs/core.py TelemetryRecorder, perceiver-telemetry-flush) is
+# allowlisted because a recorder created from the ambient
+# PERCEIVER_IO_TPU_TELEMETRY env can legitimately outlive one test while its
+# owning surface is still open — close() still always joins it, and the
+# telemetry tests assert that join directly. OUR other threads
 # (perceiver-prefetch-*, perceiver-async-ckpt) are never on this list — they
 # must ALWAYS join, including on exceptions mid-epoch.
 _BENIGN_THREAD_PREFIXES = (
@@ -204,6 +209,7 @@ _BENIGN_THREAD_PREFIXES = (
     "grpc",
     "tensorstore",
     "ocdbt",
+    "perceiver-telemetry-flush",
 )
 
 

@@ -393,6 +393,13 @@ def test_train_bench_profile_smoke(tmp_path):
     assert result["synchronous"]["steps_per_s"] > 0
     assert result["workload"]["interleaved"] is True
     assert "overlap_speedup" in result
+    # acceptance (ISSUE 6): the --profile artifact carries the per-phase time
+    # breakdown and runtime compile counts, plus a run manifest sibling
+    assert "train.fetch_wait" in result["telemetry"]["phases"]
+    assert "train.step_dispatch" in result["telemetry"]["phases"]
+    assert "compile" in result["telemetry"]
+    manifest = json.loads((tmp_path / "BENCH_train_pipeline.manifest.json").read_text())
+    assert manifest["schema"] == "run-manifest/v1" and manifest["versions"]["jax"]
 
 
 # ------------------------------------------------------------- weighted eval

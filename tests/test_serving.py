@@ -438,6 +438,14 @@ def test_serve_bench_profile_smoke(tmp_path):
         assert w["admission_speedup"] > 0
     # the baseline arm pins the single full-window bucket (tiny preset: 64)
     assert result["workloads"]["fullwindow"]["fullwindow_baseline"]["prefill_buckets"] == [64]
+    # acceptance (ISSUE 6): the --profile artifact carries the per-phase time
+    # breakdown and runtime compile counts, plus a run manifest sibling
+    telemetry = on_disk["telemetry"]
+    assert "serving.tick" in telemetry["phases"]
+    assert telemetry["compile"]["per_function"]["serving.decode_step"]["compilations"] == 1
+    assert telemetry["compile"]["unexpected"] == []
+    manifest = json.loads((tmp_path / "BENCH_serving.manifest.json").read_text())
+    assert manifest["schema"] == "run-manifest/v1" and manifest["versions"]["jax"]
 
 
 # ---------------------------------------------------------------- pipeline
