@@ -8,7 +8,13 @@ contracts of serving, training, and checkpointing.
 device prefetcher and the async checkpoint writer.
 """
 
-from perceiver_io_tpu.reliability.faults import FAULTS, FaultSpec, KilledMidWrite, armed
+from perceiver_io_tpu.reliability.faults import (
+    FAULTS,
+    FaultSpec,
+    KilledMidWrite,
+    ReplicaCrashed,
+    armed,
+)
 from perceiver_io_tpu.reliability.retry import (
     RetryError,
     RetryPolicy,
@@ -20,6 +26,7 @@ __all__ = [
     "FAULTS",
     "FaultSpec",
     "KilledMidWrite",
+    "ReplicaCrashed",
     "RetryError",
     "RetryPolicy",
     "TransientIOError",

@@ -15,6 +15,15 @@ tests and ``scripts/chaos_check.py`` arm:
                              (``slot`` param; exercises FAILED containment)
   ``serving.deadline``       sleep ``value`` seconds at a serving tick
                              boundary (forces deadline overruns)
+  ``replica.crash``          raise ``ReplicaCrashed`` at a router replica's
+                             tick (``slot`` selects the replica index; None =
+                             any) — a lost engine process; exercises failover
+  ``replica.stall``          sleep ``value`` seconds at a replica's tick —
+                             a wedged engine; exercises the router's slow-tick
+                             detector and circuit breaker
+  ``replica.slow_tick``      sleep ``value`` seconds at a replica's tick,
+                             semantically a DEGRADED (not dead) replica —
+                             inflates latency estimates for shed scenarios
   ``checkpoint.write.flaky`` raise ``TransientIOError`` before serialization
                              (absorbed by the writer's retry policy)
   ``checkpoint.write.kill``  leave a partial destination and raise
@@ -52,6 +61,9 @@ POINTS = frozenset(
         "batch.nan",
         "serving.nan",
         "serving.deadline",
+        "replica.crash",
+        "replica.stall",
+        "replica.slow_tick",
         "checkpoint.write.flaky",
         "checkpoint.write.kill",
         "checkpoint.corrupt",
@@ -61,6 +73,12 @@ POINTS = frozenset(
 
 class KilledMidWrite(RuntimeError):
     """Injected preemption mid-checkpoint-flush (``checkpoint.write.kill``)."""
+
+
+class ReplicaCrashed(RuntimeError):
+    """Injected loss of a serving-engine replica (``replica.crash``): the
+    router sees the same thing a dead engine process would produce — an
+    exception out of the replica's tick, with the device state unreachable."""
 
 
 @dataclass
@@ -153,13 +171,18 @@ class FaultRegistry:
                 )
             self._armed[point] = FaultSpec.parse(point, spec)
 
-    def fire(self, point: str) -> Optional[FaultSpec]:
+    def fire(self, point: str, target: Optional[int] = None) -> Optional[FaultSpec]:
         """Count a hit at ``point``; return the spec iff this hit fires.
-        The fast inert path (nothing armed) is one lock + dict lookup."""
+        The fast inert path (nothing armed) is one lock + dict lookup.
+        ``target`` scopes multi-instance points (replica index): a spec armed
+        with ``slot=k`` neither fires nor counts hits at other instances, so
+        ``after``/``times`` count the TARGET's own ticks deterministically."""
         with self._lock:
             self._load_env_locked()
             spec = self._armed.get(point)
             if spec is None:
+                return None
+            if spec.slot is not None and target is not None and spec.slot != target:
                 return None
             spec.hits += 1
             if spec.hits <= spec.after:
@@ -232,6 +255,25 @@ def fire_serving_tick_delay() -> None:
 def fire_serving_nan() -> Optional[FaultSpec]:
     """Serving-engine poison hook: the engine NaNs the spec's slot logits."""
     return FAULTS.fire("serving.nan")
+
+
+def fire_replica_tick(replica_id: int) -> None:
+    """Router hook at the top of one replica's tick (serving/router.py). The
+    ``slot`` field of the armed spec selects the target replica (None = every
+    replica). ``replica.crash`` raises — the router must treat the replica as
+    lost and fail its requests over; ``replica.stall``/``replica.slow_tick``
+    sleep ``value`` seconds — a wedged vs merely degraded engine (the router's
+    slow-tick detector decides which, by its own threshold)."""
+    spec = FAULTS.fire("replica.crash", target=replica_id)
+    if spec is not None:
+        raise ReplicaCrashed(
+            f"injected crash of replica {replica_id} (firing {spec.fired}"
+            f"{'' if spec.times is None else f'/{spec.times}'})"
+        )
+    for point in ("replica.stall", "replica.slow_tick"):
+        spec = FAULTS.fire(point, target=replica_id)
+        if spec is not None:
+            time.sleep(spec.value or 0.05)
 
 
 def fire_checkpoint_write(path: str) -> None:

@@ -1,0 +1,45 @@
+"""Once-only SIGTERM/SIGINT graceful-stop handlers, shared stack-wide.
+
+One implementation of the preemption pattern every long-running surface uses
+(``Trainer.fit``, ``ServingEngine``, ``ServingRouter``): the handler only
+sets a caller-provided flag — the owner drains at its next safe boundary —
+and restores the previous handlers AS IT FIRES, so a second signal takes the
+default (forceful) path instead of being swallowed. Install is main-thread
+only (the only place CPython delivers signals); elsewhere the caller simply
+gets no signal integration. docs/reliability.md documents the sequences.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional, Tuple
+
+
+def install_preemption_handler(set_flag) -> Tuple[Optional[object], dict]:
+    """Install a ONCE-ONLY SIGTERM/SIGINT handler calling ``set_flag()``.
+    Returns ``(handler, previous)`` for a symmetric close-time restore;
+    ``(None, {})`` off the main thread."""
+    if threading.current_thread() is not threading.main_thread():
+        return None, {}
+    previous: dict = {}
+
+    def on_preempt(signum, frame):
+        set_flag()
+        for s, h in previous.items():
+            signal.signal(s, h)
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        previous[s] = signal.signal(s, on_preempt)
+    return on_preempt, previous
+
+
+def restore_preemption_handler(handler, previous: dict) -> None:
+    """Put the pre-install handlers back — only where OUR handler is still
+    installed (it restores itself when it fires, and the owner must never
+    clobber a handler someone else installed since)."""
+    if handler is None:
+        return
+    for s, h in previous.items():
+        if signal.getsignal(s) is handler:
+            signal.signal(s, h)

@@ -1,9 +1,12 @@
-"""Continuous-batching serving engine (see docs/serving.md).
+"""Continuous-batching serving engine + multi-replica router (docs/serving.md).
 
 ``ServingEngine`` multiplexes many heterogeneous generation requests over a
-fixed pool of decode slots inside ONE compiled decode step; ``SlotScheduler``
-owns admission/eviction policy and ``EngineMetrics`` the observability
-surface. ``scripts/serve_bench.py`` drives a synthetic workload through it.
+fixed pool of decode slots inside ONE compiled decode step; ``ServingRouter``
+fronts N engine replicas with health-checked dispatch, circuit breakers,
+deterministic failover, and SLO-aware shedding (docs/reliability.md).
+``SlotScheduler`` owns admission/eviction policy, ``EngineMetrics`` /
+``RouterMetrics`` the observability surface. ``scripts/serve_bench.py``
+drives synthetic workloads through both.
 """
 
 from perceiver_io_tpu.serving.engine import (
@@ -14,14 +17,22 @@ from perceiver_io_tpu.serving.engine import (
     SlotState,
     default_prefill_buckets,
 )
-from perceiver_io_tpu.serving.metrics import EngineMetrics, load_metrics_jsonl
+from perceiver_io_tpu.serving.metrics import (
+    EngineMetrics,
+    RouterMetrics,
+    load_metrics_jsonl,
+)
+from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
 __all__ = [
     "EngineMetrics",
     "RequestStatus",
+    "RoutedRequest",
+    "RouterMetrics",
     "ServedRequest",
     "ServingEngine",
+    "ServingRouter",
     "SlotScheduler",
     "SlotState",
     "TERMINAL_STATUSES",

@@ -57,7 +57,7 @@ Telemetry (docs/observability.md): ``ServingEngine(telemetry=...)`` (or the
 ``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans per tick (admit /
 prefill dispatch / install / decode dispatch / sample-sync / evict),
 per-request lifecycle spans keyed by request id (joinable against the
-serving-metrics/v3 JSONL events), and a compile watchdog that flags any
+serving-metrics/v4 JSONL events), and a compile watchdog that flags any
 program count growing past the churn-never-recompiles budgets at runtime.
 Off by default; the disabled path holds the shared no-op recorder and the
 greedy-parity and compile-count pins run through it unchanged.
@@ -93,6 +93,10 @@ from perceiver_io_tpu.generation.sampling import process_logits_batched, sample_
 from perceiver_io_tpu.obs.core import resolve_recorder
 from perceiver_io_tpu.obs.watchdog import CompileWatchdog
 from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.preemption import (
+    install_preemption_handler,
+    restore_preemption_handler,
+)
 from perceiver_io_tpu.serving.metrics import EngineMetrics
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
@@ -165,6 +169,13 @@ class ServedRequest:
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
     deadline_s: Optional[float] = None  # TTL from submit; enforced at ticks
+    # deterministic state replay (router failover, docs/serving.md): tokens
+    # force-fed through the compiled decode step after prefill, reproducing
+    # the source engine's exact decode trajectory — including the rng chain —
+    # before free-running generation resumes. Replayed tokens are re-emitted
+    # into ``output_ids`` (the handle carries the full stream).
+    replay_ids: Optional[np.ndarray] = None
+    replay_pos: int = 0
 
     @property
     def done(self) -> bool:
@@ -246,16 +257,30 @@ class ServingEngine:
         max_queue_depth: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
         telemetry=None,
+        obs_ns: str = "serving",
+        handle_preemption: bool = False,
     ):
         self.model = model
         self.params = params
         self.num_slots = num_slots
+        # observability namespace: a router fronting N engines on ONE shared
+        # recorder gives each replica its own prefix ("serving.r0", ...) so
+        # phase tables stay per-replica (scripts/obs_report.py); standalone
+        # engines keep the documented "serving.*" names
+        self._obs_ns = obs_ns
+        self._span_tick = f"{obs_ns}.tick"
+        self._span_admit = f"{obs_ns}.admit"
+        self._span_prefill = f"{obs_ns}.prefill_dispatch"
+        self._span_install = f"{obs_ns}.install"
+        self._span_decode_dispatch = f"{obs_ns}.decode_dispatch"
+        self._span_sample_sync = f"{obs_ns}.sample_sync"
+        self._span_evict = f"{obs_ns}.evict"
         self.cache_dtype = cache_dtype if cache_dtype is not None else _cache_dtype(model)
         self.scheduler: SlotScheduler[ServedRequest] = SlotScheduler(num_slots)
         self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
         # unified telemetry (docs/observability.md): phase spans per tick,
         # per-request lifecycle spans keyed by request id (joinable against
-        # the serving-metrics/v3 events carrying the same request_id), and a
+        # the serving-metrics/v4 events carrying the same request_id), and a
         # compile watchdog policing the churn-never-recompiles invariant at
         # runtime. Off by default: ``telemetry=None`` defers to the
         # PERCEIVER_IO_TPU_TELEMETRY env, and the disabled surface is the
@@ -286,6 +311,29 @@ class ServingEngine:
         # — a no-deadline engine with a deep backlog must not pay O(queue)
         # predicate calls per generated token
         self._deadlines_seen = default_deadline_s is not None
+        # dispatch/harvest split state: the in-flight (occupied, tok, finite,
+        # t0) of a dispatched-but-not-synced decode step (see step_dispatch)
+        self._pending_harvest = None
+        # slots currently replaying a forced token stream (slot -> request);
+        # empty on the hot path, where the cached all-zeros device arrays
+        # below make the forced-token mux free of host->device transfers
+        self._replay_slots: Dict[int, ServedRequest] = {}
+        # SIGTERM/SIGINT graceful drain (docs/reliability.md): the handler
+        # only sets a flag; the next tick closes admission and rejects the
+        # backlog, active slots run to completion, and the final
+        # metrics snapshot + telemetry flush land before the loop exits —
+        # a signal mid-tick must not strand the JSONL or the trace.
+        self.preempted = False
+        self._preempt_requested = False
+        self._preempt_flushed = False
+        self._preempt_handler = None
+        self._preempt_previous: dict = {}
+        if handle_preemption:
+            def _request_preempt():
+                self._preempt_requested = True
+            self._preempt_handler, self._preempt_previous = (
+                install_preemption_handler(_request_preempt)
+            )
 
         cfg = model.config
         self._vocab = cfg.vocab_size
@@ -325,21 +373,25 @@ class ServingEngine:
         # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
         # serving); storing them narrower would silently cast at install
         self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
+        # device-resident constants for the no-replay case: the forced-token
+        # mux costs no host->device transfer on ordinary ticks
+        self._forced_none = jnp.zeros((num_slots,), jnp.int32)
+        self._use_forced_none = jnp.zeros((num_slots,), bool)
         self._build_jits()
         if self.watchdog is not None:
             # the engine's own compile-count pins, as runtime budgets: one
             # decode/install/release/quarantine program ever, <= one prefill
             # program per ladder bucket (tests/test_serving.py churn test)
-            self.watchdog.watch("serving.decode_step", self._jit_decode, budget=1)
-            self.watchdog.watch("serving.prefill", self._jit_prefill,
+            self.watchdog.watch(f"{obs_ns}.decode_step", self._jit_decode, budget=1)
+            self.watchdog.watch(f"{obs_ns}.prefill", self._jit_prefill,
                                 budget=len(self.prefill_buckets))
             # install consumes the BUCKET-shaped req_cache, so like prefill it
             # owns one legitimate program per ladder bucket (the churn test's
             # "<= ladder prefill+install programs" bound)
-            self.watchdog.watch("serving.install", self._jit_install,
+            self.watchdog.watch(f"{obs_ns}.install", self._jit_install,
                                 budget=len(self.prefill_buckets))
-            self.watchdog.watch("serving.release", self._jit_release, budget=1)
-            self.watchdog.watch("serving.quarantine", self._jit_quarantine, budget=1)
+            self.watchdog.watch(f"{obs_ns}.release", self._jit_release, budget=1)
+            self.watchdog.watch(f"{obs_ns}.quarantine", self._jit_quarantine, budget=1)
 
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
@@ -401,7 +453,7 @@ class ServingEngine:
             )
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, cache, state):
+        def decode_step(params, cache, state, forced, use_forced):
             # Mirrors _generate_single's loop body per row: process logits ->
             # sample -> one cached model step. Inactive rows decode their pad
             # token; their outputs are never harvested.
@@ -417,6 +469,13 @@ class ServingEngine:
             keys = jax.vmap(jax.random.split)(state.rng)  # (B, 2, 2)
             tok = sample_token_batched(keys[:, 1], processed, state.do_sample)
             tok = jnp.where(state.active, tok, state.pad_id).astype(jnp.int32)
+            # deterministic replay mux (router failover): a replaying slot's
+            # token is FORCED to the known stream while the rng chain, cache
+            # appends, and logits advance exactly as in the original run —
+            # so free-running continuation is bit-identical. With use_forced
+            # all-False (every ordinary tick) this is a no-op select and the
+            # f64 parity pins run through it.
+            tok = jnp.where(use_forced, forced, tok).astype(jnp.int32)
             logits_t, cache = model.apply(
                 params, tok[:, None], cache, method=type(model).decode_step
             )
@@ -464,6 +523,17 @@ class ServingEngine:
         """Number of compiled prefill programs (target: <= len(prefill_buckets))."""
         return self._jit_prefill._cache_size()
 
+    @property
+    def total_compilations(self) -> int:
+        """Total compiled programs across every engine jit — the router's
+        compile-tick detector: a tick whose count moved paid a compile, so
+        its duration must not count as a stall strike (five int reads,
+        cheap enough per tick)."""
+        return sum(f._cache_size() for f in (
+            self._jit_prefill, self._jit_install, self._jit_decode,
+            self._jit_release, self._jit_quarantine,
+        ))
+
     # ------------------------------------------------------------------ submit
     def submit(
         self,
@@ -471,12 +541,18 @@ class ServingEngine:
         config: Optional[GenerationConfig] = None,
         rng: Optional[jax.Array] = None,
         deadline_s: Optional[float] = None,
+        replay_ids: Optional[Sequence[int]] = None,
         **kwargs,
     ) -> ServedRequest:
         """Queue one request; returns its handle. ``config``/kwargs follow
         ``generate()``'s convention (pass one or the other). ``deadline_s``
         is a TTL from now (falls back to the engine's ``default_deadline_s``);
         an expired request is evicted ``TIMED_OUT`` at the next tick.
+        ``replay_ids`` force-feeds a known token stream through the decode
+        step after prefill — deterministic state reconstruction for router
+        failover (the replayed tokens are re-emitted into ``output_ids`` and
+        count toward ``max_new_tokens``); generation free-runs after the
+        stream is exhausted.
 
         MALFORMED requests (empty prompt, unservable config) raise ValueError
         — they are caller bugs. WELL-FORMED requests the pool cannot serve
@@ -509,6 +585,8 @@ class ServingEngine:
             rng=rng,
             submitted_at=time.perf_counter(),
             deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
+            replay_ids=np.asarray(replay_ids, np.int32).reshape(-1)
+            if replay_ids is not None and len(replay_ids) else None,
         )
         if request.deadline_s is not None:
             self._deadlines_seen = True
@@ -528,10 +606,7 @@ class ServingEngine:
         # so a raw queue_depth check would reject a burst into an idle
         # engine while its slots sit free. max_queue_depth=0 therefore
         # means "no waiting beyond what the free slots will absorb".
-        if (
-            self.max_queue_depth is not None
-            and self.scheduler.queue_depth - self.scheduler.free_slots >= self.max_queue_depth
-        ):
+        if self.max_queue_depth is not None and self.scheduler.load >= self.max_queue_depth:
             return self._reject(request, "queue_full")
         self._requests[request.request_id] = request
         self.scheduler.enqueue(request)
@@ -548,7 +623,7 @@ class ServingEngine:
         self.finished.append(request)
         self.metrics.record_reject(request.request_id, reason)
         if self._obs_on:
-            self._obs.counter_inc("serving.rejected")
+            self._obs.counter_inc(f"{self._obs_ns}.rejected")
             self._obs.async_end(self._span_cat, request.request_id,
                                 status="rejected", reason=reason)
         return request
@@ -576,10 +651,10 @@ class ServingEngine:
         cfg = request.config
         t0 = time.perf_counter()
         bucket = self._bucket_for(request.prompt_ids.size)
-        with self._obs.span("serving.prefill_dispatch"):
+        with self._obs.span(self._span_prefill):
             ids, pad_mask = self._bucket_prompt(request, bucket)
             req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
-        with self._obs.span("serving.install"):
+        with self._obs.span(self._span_install):
             self._cache, self._state = self._jit_install(
                 self._cache, self._state, slot, req_cache, req_logits, request.rng,
                 # greedy requests ignore temperature/top_k/top_p (argmax survives
@@ -600,6 +675,8 @@ class ServingEngine:
         now = time.perf_counter()
         request.status = RequestStatus.RUNNING
         request.slot = slot
+        if request.replay_ids is not None and request.replay_pos < request.replay_ids.size:
+            self._replay_slots[slot] = request
         request.admitted_at = now
         self.metrics.record_admit(
             request.request_id, slot, wait_s=now - request.submitted_at,
@@ -614,6 +691,7 @@ class ServingEngine:
         status: RequestStatus = RequestStatus.FINISHED,
     ) -> None:
         self.scheduler.release(slot)
+        self._replay_slots.pop(slot, None)
         self._state = self._jit_release(self._state, slot)
         request.status = status
         request.finish_reason = reason
@@ -629,6 +707,43 @@ class ServingEngine:
             self._obs.async_end(self._span_cat, request.request_id,
                                 status=status.value, reason=reason,
                                 new_tokens=len(request.output_ids))
+
+    def evict_request(
+        self, request_id: int, reason: str = "cancelled",
+        status: RequestStatus = RequestStatus.FAILED,
+        queued_only: bool = False,
+    ) -> Optional[ServedRequest]:
+        """Cancel one non-terminal request wherever it sits — queued (leaves
+        the queue, never costs a prefill) or running (slot released, partial
+        output preserved on the handle exactly as TIMED_OUT eviction keeps
+        it). Returns the now-terminal handle, or None for an unknown/already
+        terminal id. ``queued_only`` restricts the cancel to host-side
+        bookkeeping (a running eviction touches device state, which a caller
+        probing a suspect engine may not trust yet). This is the eviction API
+        the router's failover uses to reclaim a lost replica's stale requests
+        (serving/router.py); it is also the building block for client-side
+        cancellation."""
+        request = self._requests.get(request_id)
+        if request is None:
+            return None
+        if request.slot is not None:
+            if queued_only:
+                return None
+            self._evict(request.slot, request, reason, status=status)
+            return request
+        removed = self.scheduler.prune_queue(lambda r: r is request)
+        if not removed:  # defensive: _requests said queued but the queue disagrees
+            return None
+        self._requests.pop(request_id, None)
+        request.status = status
+        request.finish_reason = reason
+        request.finished_at = time.perf_counter()
+        self.finished.append(request)
+        self.metrics.record_evict_queued(request_id, reason, status=status.value)
+        if self._obs_on:
+            self._obs.async_end(self._span_cat, request_id,
+                                status=status.value, reason=reason, new_tokens=0)
+        return request
 
     # --------------------------------------------------------------- deadlines
     def _expire_deadlines(self, now: float) -> None:
@@ -674,67 +789,173 @@ class ServingEngine:
         )
 
     # -------------------------------------------------------------------- step
-    def step(self) -> bool:
-        """One scheduler tick: expire deadlines, admit queued requests into
-        free slots, advance every occupied slot one token, harvest/evict
-        finished (or contained) requests. Returns True while work remains
-        (occupied slots or queued requests)."""
+    def step_dispatch(self) -> bool:
+        """First half of a tick: expire deadlines, admit queued requests into
+        free slots, DISPATCH the batched decode step — no device sync.
+        Returns True when a decode is now in flight (``step_harvest`` must run
+        before the next dispatch). The split exists for the router
+        (serving/router.py): dispatching every replica's decode before
+        harvesting any overlaps each replica's device step with its siblings'
+        sync + host bookkeeping — the aggregate-throughput win ``serve_bench
+        --replicas`` measures. ``step()`` composes the halves back into the
+        single-engine tick, unchanged."""
+        if self._pending_harvest is not None:
+            raise RuntimeError("step_harvest() must run before the next step_dispatch()")
         faults.fire_serving_tick_delay()  # injected stall (deadline-overrun chaos)
-        with self._obs.span("serving.tick"):
+        if self._preempt_requested and not self._draining:
+            # signal-initiated graceful drain: admission closes and the
+            # backlog is rejected HERE, at a tick boundary — never inside the
+            # signal handler, which only sets the flag
+            self.preempted = True
+            self._begin_drain()
+        # tick span as a begin/end pair: it brackets both halves, which the
+        # obs core pairs per (thread, name) — same "X" event as the old
+        # with-block, now router-interleavable. An exception anywhere in the
+        # half must still balance the span (a dead replica's dangling begin
+        # would sit in the recorder's open-span stack forever).
+        self._obs.span_begin(self._span_tick)
+        try:
             if self._deadlines_seen:
                 self._expire_deadlines(time.perf_counter())
             if not self._draining:
-                with self._obs.span("serving.admit"):
+                with self._obs.span(self._span_admit):
                     for slot, request in self.scheduler.pop_admissible():
                         self._admit(slot, request)
             self._maybe_inject_nan()
             occupied = list(self.scheduler.occupied())
             if self._obs_on:
-                self._obs.gauge_set("serving.active_slots", len(occupied))
-                self._obs.gauge_set("serving.queue_depth", self.scheduler.queue_depth)
+                self._obs.gauge_set(f"{self._obs_ns}.active_slots", len(occupied))
+                self._obs.gauge_set(f"{self._obs_ns}.queue_depth", self.scheduler.queue_depth)
             if not occupied:
-                return self.scheduler.has_work
+                self._obs.span_end(self._span_tick)
+                return False
 
+            if self._replay_slots:
+                forced_np = np.zeros((self.num_slots,), np.int32)
+                use_np = np.zeros((self.num_slots,), bool)
+                for slot, request in self._replay_slots.items():
+                    forced_np[slot] = int(request.replay_ids[request.replay_pos])
+                    use_np[slot] = True
+                forced, use_forced = jnp.asarray(forced_np), jnp.asarray(use_np)
+            else:
+                forced, use_forced = self._forced_none, self._use_forced_none
             t0 = time.perf_counter()
-            with self._obs.span("serving.decode_dispatch"):
+            with self._obs.span(self._span_decode_dispatch):
                 # dispatch only — the jit call returns before the device step
-                # finishes; the device cost lands in the sample-sync below
+                # finishes; the device cost lands in the sample-sync at harvest
                 tok, finite, self._cache, self._state = self._jit_decode(
-                    self.params, self._cache, self._state
+                    self.params, self._cache, self._state, forced, use_forced
                 )
-            with self._obs.span("serving.sample_sync"):
-                tok = np.asarray(tok)  # blocks: the step's ONE device sync point
-                finite = np.asarray(finite)  # already on host after the sync above
-            decode_s = time.perf_counter() - t0
-            # tokens_generated counts USEFUL tokens only: a quarantined slot's
-            # garbage sample is never emitted, so it must not inflate the count
-            useful = sum(1 for slot, _ in occupied if finite[slot])
-            self.metrics.record_decode_step(len(occupied), decode_s, tokens=useful)
+        except BaseException:
+            self._obs.span_end(self._span_tick)
+            raise
+        self._pending_harvest = (occupied, tok, finite, t0)
+        return True
 
-            with self._obs.span("serving.evict"):
-                for slot, request in occupied:
-                    if not finite[slot]:
-                        # containment: the token sampled from non-finite logits
-                        # is garbage — never emitted — and the slot's
-                        # cache/state rows are zeroed so nothing non-finite
-                        # survives in the pool
-                        self._cache = self._jit_quarantine(self._cache, slot)
-                        self._evict(slot, request, "nonfinite_logits",
-                                    status=RequestStatus.FAILED)
-                        continue
-                    token = int(tok[slot])
-                    request.output_ids.append(token)
-                    cfg = request.config
-                    if cfg.eos_token_id is not None and token == cfg.eos_token_id:
-                        self._evict(slot, request, "eos")
-                    elif len(request.output_ids) >= cfg.max_new_tokens:
-                        self._evict(slot, request, "length")
-            if self.watchdog is not None:
-                # per-tick budget poll: one int read per watched program — any
-                # growth past the churn-never-recompiles budgets is flagged
-                # (counter compile.unexpected + instant trace event), never raised
-                self.watchdog.check()
+    def step_harvest(self) -> bool:
+        """Second half of a tick: the tick's ONE device sync on the dispatched
+        tokens, then harvest/evict finished (or contained) requests. Returns
+        True while work remains (occupied slots or queued requests). A no-op
+        returning ``has_work`` when nothing was dispatched."""
+        pending, self._pending_harvest = self._pending_harvest, None
+        if pending is None:
+            self._maybe_flush_preempted()
+            return self.scheduler.has_work
+        try:
+            return self._harvest(pending)
+        except BaseException:
+            # balance the tick span opened by step_dispatch even when the
+            # sync/evict path dies (the replica-loss domain)
+            self._obs.span_end(self._span_tick)
+            raise
+
+    def _harvest(self, pending) -> bool:
+        occupied, tok, finite, t0 = pending
+        with self._obs.span(self._span_sample_sync):
+            tok = np.asarray(tok)  # blocks: the step's ONE device sync point
+            finite = np.asarray(finite)  # already on host after the sync above
+        decode_s = time.perf_counter() - t0
+        # tokens_generated counts USEFUL tokens only: a quarantined slot's
+        # garbage sample is never emitted, and a REPLAYED token was already
+        # delivered once by the engine that originally generated it — counting
+        # it again would double-book the salvaged prefix in a router
+        # snapshot's per-replica sum (decode_steps/decode_seconds still count
+        # the replay's device work, honestly)
+        useful = sum(
+            1 for slot, _ in occupied
+            if finite[slot] and slot not in self._replay_slots
+        )
+        self.metrics.record_decode_step(len(occupied), decode_s, tokens=useful)
+
+        with self._obs.span(self._span_evict):
+            for slot, request in occupied:
+                if self.scheduler.occupant(slot) is not request:
+                    # the request left its slot between dispatch and harvest
+                    # (evict_request cancellation, deadline expiry): its
+                    # in-flight token must not land on a terminal handle, and
+                    # a re-evict would double-free the slot
+                    continue
+                if not finite[slot]:
+                    # containment: the token sampled from non-finite logits
+                    # is garbage — never emitted — and the slot's
+                    # cache/state rows are zeroed so nothing non-finite
+                    # survives in the pool
+                    self._cache = self._jit_quarantine(self._cache, slot)
+                    self._evict(slot, request, "nonfinite_logits",
+                                status=RequestStatus.FAILED)
+                    continue
+                token = int(tok[slot])
+                request.output_ids.append(token)
+                if slot in self._replay_slots:
+                    # one replayed token landed; free-running resumes when
+                    # the forced stream is exhausted
+                    request.replay_pos += 1
+                    if request.replay_pos >= request.replay_ids.size:
+                        del self._replay_slots[slot]
+                cfg = request.config
+                if cfg.eos_token_id is not None and token == cfg.eos_token_id:
+                    self._evict(slot, request, "eos")
+                elif len(request.output_ids) >= cfg.max_new_tokens:
+                    self._evict(slot, request, "length")
+        if self.watchdog is not None:
+            # per-tick budget poll: one int read per watched program — any
+            # growth past the churn-never-recompiles budgets is flagged
+            # (counter compile.unexpected + instant trace event), never raised
+            self.watchdog.check()
+        self._obs.span_end(self._span_tick)
+        self._maybe_flush_preempted()
         return self.scheduler.has_work
+
+    def step(self) -> bool:
+        """One scheduler tick: expire deadlines, admit queued requests into
+        free slots, advance every occupied slot one token, harvest/evict
+        finished (or contained) requests. Returns True while work remains
+        (occupied slots or queued requests)."""
+        self.step_dispatch()
+        return self.step_harvest()
+
+    def discard_pending_harvest(self) -> None:
+        """Drop a dispatched-but-unharvested decode step without syncing it
+        (defensive; the router calls it before reusing a recovered replica in
+        case a failure ever lands between dispatch and harvest). Such a
+        half-tick's requests were failed over, so its tokens must never
+        land; the orphaned step's device-side effect is per-slot state that
+        the next admission's ``write_slot`` fully overwrites — the normal
+        churn contract. Balances the tick span the dispatch opened (a
+        dangling begin would sit in the recorder's open-span stack
+        forever)."""
+        if self._pending_harvest is not None:
+            self._pending_harvest = None
+            self._obs.span_end(self._span_tick)
+
+    def _maybe_flush_preempted(self) -> None:
+        """Once a signal-initiated drain has emptied the engine, flush the
+        terminal metrics snapshot and close the telemetry/JSONL surfaces —
+        the whole point of the graceful path is that the artifacts land."""
+        if self.preempted and not self._preempt_flushed and not self.scheduler.has_work:
+            self._preempt_flushed = True
+            self.metrics.write_snapshot()
+            self.close()
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> List[ServedRequest]:
         """Step until every submitted request finished; returns (and drains)
@@ -749,14 +970,19 @@ class ServingEngine:
         drained, self.finished = self.finished, []
         return drained
 
+    def _begin_drain(self) -> None:
+        """Close admission and reject the queued backlog (shared by explicit
+        ``drain()`` and the SIGTERM/SIGINT graceful path)."""
+        self._draining = True
+        for request in self.scheduler.prune_queue(lambda r: True):
+            self._reject(request, "draining")
+
     def drain(self, max_steps: Optional[int] = None) -> List[ServedRequest]:
         """Graceful shutdown: stop admitting (subsequent submits are
         REJECTED), reject the queued backlog, and run the ACTIVE slots to
         completion — in-flight work is finished, not dropped. Returns the
         drained terminal handles (completion order, rejected backlog first)."""
-        self._draining = True
-        for request in self.scheduler.prune_queue(lambda r: True):
-            self._reject(request, "draining")
+        self._begin_drain()
         return self.run_until_drained(max_steps=max_steps)
 
     # --------------------------------------------------------------- telemetry
@@ -783,6 +1009,8 @@ class ServingEngine:
         recorder from a knob/env rather than being handed one — the recorder
         itself (which writes its Chrome trace if a path was configured).
         Idempotent; caller-owned recorders are left open."""
+        restore_preemption_handler(self._preempt_handler, self._preempt_previous)
+        self._preempt_handler = None
         self.metrics.close()
         if self.watchdog is not None:
             self.watchdog.close()

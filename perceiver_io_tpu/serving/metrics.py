@@ -31,6 +31,18 @@ Schema history:
     ``queue_depth`` was already snapshotted. ``load_metrics_jsonl`` reads all
     versions (older snapshots are normalized with ``None`` for the fields
     their writers did not record).
+  * ``serving-metrics/v4`` — the multi-replica schema (docs/serving.md,
+    router section): snapshots gain ``failovers`` (requests re-dispatched to
+    a surviving replica after their engine was lost), ``shed_infeasible``
+    (admission-time SLO sheds — deadlines the windowed latency estimates say
+    cannot be met), and ``breaker_transitions`` (circuit-breaker state-change
+    counters keyed ``"closed->open"`` etc.). Router snapshots additionally
+    carry a ``replicas`` section mapping replica name -> that engine's own
+    snapshot, and the router JSONL stream adds ``dispatch`` / ``failover`` /
+    ``shed`` / ``breaker`` events. Plain-engine snapshots report the new
+    counters as 0 (an engine cannot fail over or shed by estimate); the
+    reader normalizes v3-and-older snapshots with ``None`` — "not recorded"
+    stays distinguishable from "none happened", the v2->v3 discipline.
 """
 
 from __future__ import annotations
@@ -43,9 +55,15 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v3"
-KNOWN_SCHEMAS = ("serving-metrics/v1", "serving-metrics/v2", "serving-metrics/v3")
+SCHEMA = "serving-metrics/v4"
+KNOWN_SCHEMAS = (
+    "serving-metrics/v1",
+    "serving-metrics/v2",
+    "serving-metrics/v3",
+    "serving-metrics/v4",
+)
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
+_V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -105,17 +123,82 @@ def load_metrics_jsonl(path: str) -> Dict:
                 none_lat = {"mean": None, "max": None, "p50": None, "p95": None}
                 snap.setdefault("prefill_s", dict(none_lat))
                 snap.setdefault("decode_step_s", dict(none_lat))
-            if schema != "serving-metrics/v3":
+            if schema in ("serving-metrics/v1", "serving-metrics/v2"):
                 # pre-v3 writers had no admission-control outcomes: None, not
                 # 0 — "not recorded" must stay distinguishable from "none"
                 for k in _V3_COUNTERS:
+                    snap.setdefault(k, None)
+            if schema != "serving-metrics/v4":
+                # pre-v4 writers had no multi-replica counters: same None
+                # discipline (a v3 engine never measured failovers — it did
+                # not run zero of them)
+                for k in _V4_FIELDS:
                     snap.setdefault(k, None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
 
+class _JsonlMetrics:
+    """Shared JSONL-emitter plumbing for ``EngineMetrics``/``RouterMetrics``:
+    one line-buffered append handle for the owner's lifetime, terminal
+    idempotent ``close()``, and shutdown-race-guarded teardown. Subclasses are
+    dataclasses providing ``jsonl_path``/``_jsonl_file``/``_closed`` fields."""
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.jsonl_path is None or self._closed:
+            # a closed metrics object silently drops events instead of
+            # resurrecting its handle: close() is a real end-of-life, and an
+            # _emit racing interpreter teardown must not call open()
+            return
+        if self._jsonl_file is None:
+            # one line-buffered handle for the owner's lifetime: _emit runs
+            # once per decoded token, so per-event open/close syscalls would
+            # tax the hot decode loop; line buffering keeps readers current
+            self._jsonl_file = open(self.jsonl_path, "a", buffering=1)
+        record = {"event": event, "ts": round(time.time(), 6), **fields}
+        self._jsonl_file.write(json.dumps(record) + "\n")
+
+    def _route_status(self, status: str) -> None:
+        """Route one terminal outcome into the shared counter fields. Both
+        metrics classes carry the same four counters; ONE router keeps the
+        JSONL status strings and the snapshot counters from diverging (an
+        eviction recorded as "rejected" must never count as finished)."""
+        if status == "timed_out":
+            self.requests_timed_out += 1
+        elif status == "failed":
+            self.requests_failed += 1
+        elif status == "rejected":
+            self.requests_rejected += 1
+        else:
+            self.requests_finished += 1
+
+    def close(self) -> None:
+        """Release the JSONL handle. Terminal and idempotent: a second close
+        is a no-op, and later ``_emit`` calls are dropped instead of
+        resurrecting the handle. Guarded against interpreter-shutdown races —
+        ``getattr`` with a True default means a close racing module teardown
+        (``__del__`` during finalization, partially torn-down instance) bails
+        out instead of raising."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        f = self._jsonl_file
+        self._jsonl_file = None
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass  # a handle torn down by interpreter exit is already closed
+
+    def __del__(self):  # best-effort backstop; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 @dataclass
-class EngineMetrics:
+class EngineMetrics(_JsonlMetrics):
     """Mutable counters owned by one ``ServingEngine``; never touches jax."""
 
     num_slots: int
@@ -142,20 +225,6 @@ class EngineMetrics:
     _closed: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------------ events
-    def _emit(self, event: str, **fields) -> None:
-        if self.jsonl_path is None or self._closed:
-            # a closed metrics object silently drops events instead of
-            # resurrecting its handle: close() is a real end-of-life, and an
-            # _emit racing interpreter teardown must not call open()
-            return
-        if self._jsonl_file is None:
-            # one line-buffered handle for the engine's lifetime: _emit runs
-            # once per decoded token, so per-event open/close syscalls would
-            # tax the hot decode loop; line buffering keeps readers current
-            self._jsonl_file = open(self.jsonl_path, "a", buffering=1)
-        record = {"event": event, "ts": round(time.time(), 6), **fields}
-        self._jsonl_file.write(json.dumps(record) + "\n")
-
     def record_submit(self, request_id: int, prompt_len: int) -> None:
         if self._start_time is None:
             self._start_time = time.perf_counter()
@@ -191,13 +260,9 @@ class EngineMetrics:
         status: str = "finished",
     ) -> None:
         """Terminal event for a request that held a slot. ``status`` routes
-        the counter: "finished" (success), "timed_out", or "failed"."""
-        if status == "timed_out":
-            self.requests_timed_out += 1
-        elif status == "failed":
-            self.requests_failed += 1
-        else:
-            self.requests_finished += 1
+        the counter: "finished" (success), "timed_out", "failed", or
+        "rejected" (a cancelled-while-running eviction)."""
+        self._route_status(status)
         self._emit("finish", request_id=request_id, slot=slot,
                    new_tokens=new_tokens, reason=reason, status=status)
 
@@ -212,12 +277,36 @@ class EngineMetrics:
     def record_timeout_queued(self, request_id: int, reason: str = "deadline") -> None:
         """Terminal event for a QUEUED request whose deadline expired before
         it ever reached a slot."""
-        self.requests_timed_out += 1
+        self.record_evict_queued(request_id, reason, status="timed_out")
+
+    def record_evict_queued(self, request_id: int, reason: str, status: str) -> None:
+        """Terminal event for a QUEUED request evicted before reaching a slot
+        (deadline expiry, cancellation, failover reclaim). ``status`` routes
+        the counter exactly as ``record_finish`` does for slot-holders."""
+        self._route_status(status)
         self.queue_depth = max(self.queue_depth - 1, 0)
         self._emit("finish", request_id=request_id, slot=None, new_tokens=0,
-                   reason=reason, status="timed_out")
+                   reason=reason, status=status)
 
     # ---------------------------------------------------------------- snapshot
+    def latency_estimates(self) -> Optional[Dict[str, float]]:
+        """Windowed p95s for the router's SLO feasibility estimate
+        (serving/router.py): queue wait, prefill dispatch, decode step, plus
+        the lifetime decode-step count as the warm-up gate. None until the
+        engine has decoded at all — cold estimates must never drive
+        admission decisions. Cheaper than ``snapshot()`` (three percentiles,
+        no dict assembly) because the router may call it per submit."""
+        if not self._decode_times:
+            return None
+        return {
+            "queue_wait_p95_s": float(np.percentile(list(self._queue_waits), 95))
+            if self._queue_waits else 0.0,
+            "prefill_p95_s": float(np.percentile(list(self._prefill_times), 95))
+            if self._prefill_times else 0.0,
+            "decode_step_p95_s": float(np.percentile(list(self._decode_times), 95)),
+            "decode_steps": self.decode_steps,
+        }
+
     def snapshot(self) -> Dict:
         wall = (time.perf_counter() - self._start_time) if self._start_time else 0.0
         snap = {
@@ -229,6 +318,12 @@ class EngineMetrics:
             "rejected": self.requests_rejected,
             "timed_out": self.requests_timed_out,
             "failed": self.requests_failed,
+            # v4 fields, constant at a single engine: failing over, shedding
+            # by estimate, and breaker state are ROUTER behaviors — 0 here
+            # (truthfully "none happened"), real values in RouterMetrics
+            "failovers": 0,
+            "shed_infeasible": 0,
+            "breaker_transitions": {},
             "queue_depth": self.queue_depth,
             "tokens_generated": self.tokens_generated,
             "decode_steps": self.decode_steps,
@@ -253,26 +348,106 @@ class EngineMetrics:
         self._emit("snapshot", **snap)
         return snap
 
-    def close(self) -> None:
-        """Release the JSONL handle. Terminal and idempotent: a second close
-        is a no-op, and later ``_emit`` calls are dropped instead of
-        resurrecting the handle. Guarded against interpreter-shutdown races —
-        ``getattr`` with a True default means a close racing module teardown
-        (``__del__`` during finalization, partially torn-down instance) bails
-        out instead of raising."""
-        if getattr(self, "_closed", True):
-            return
-        self._closed = True
-        f = self._jsonl_file
-        self._jsonl_file = None
-        if f is not None:
-            try:
-                f.close()
-            except Exception:
-                pass  # a handle torn down by interpreter exit is already closed
 
-    def __del__(self):  # best-effort backstop; close() is the real contract
-        try:
-            self.close()
-        except Exception:
-            pass
+@dataclass
+class RouterMetrics(_JsonlMetrics):
+    """Counters owned by one ``ServingRouter`` (serving/router.py): the
+    router-level outcomes — dispatch, failover, shed, breaker transitions —
+    plus per-replica engine snapshots embedded under ``replicas``. The JSONL
+    stream interleaves router events (``submit``/``dispatch``/``failover``/
+    ``shed``/``breaker``/``finish``) with a terminal v4 ``snapshot``;
+    per-engine streams stay separate (``ServingRouter`` forwards its
+    ``replica_metrics_jsonl`` template — ``"{i}"`` = replica index — to each
+    engine's own JSONL knob)."""
+
+    num_replicas: int
+    jsonl_path: Optional[str] = None
+
+    requests_submitted: int = 0
+    requests_dispatched: int = 0  # engine submits accepted by a replica
+    requests_finished: int = 0
+    requests_rejected: int = 0  # all router-level refusals, sheds included
+    requests_timed_out: int = 0
+    requests_failed: int = 0  # containment + max_failovers exhaustion
+    failovers: int = 0  # re-dispatches of a lost replica's live requests
+    shed_infeasible: int = 0  # admission-time SLO sheds (subset of rejected)
+    breaker_transitions: Dict[str, int] = field(default_factory=dict)
+    _start_time: Optional[float] = None
+    _jsonl_file: Optional[object] = field(default=None, repr=False)
+    _closed: bool = field(default=False, repr=False)
+
+    # ------------------------------------------------------------------ events
+    def record_submit(self, request_id: int, prompt_len: int) -> None:
+        if self._start_time is None:
+            self._start_time = time.perf_counter()
+        self.requests_submitted += 1
+        self._emit("submit", request_id=request_id, prompt_len=prompt_len)
+
+    def record_dispatch(self, request_id: int, replica: int, load: int) -> None:
+        """One accepted hand-off to a replica's engine (initial dispatch or a
+        failover re-dispatch); ``load`` is the replica's queue-beyond-capacity
+        score at decision time — the dispatch policy's own input, logged so
+        imbalance is diagnosable from the stream alone."""
+        self.requests_dispatched += 1
+        self._emit("dispatch", request_id=request_id, replica=replica, load=load)
+
+    def record_failover(self, request_id: int, from_replica: int,
+                        emitted_tokens: int, failover_n: int) -> None:
+        self.failovers += 1
+        self._emit("failover", request_id=request_id, from_replica=from_replica,
+                   emitted_tokens=emitted_tokens, failover_n=failover_n)
+
+    def record_shed(self, request_id: int, deadline_s: float, estimate_s: float) -> None:
+        """An admission-time SLO shed: the windowed latency estimate says the
+        deadline cannot be met, so the request is REJECTED before it queues
+        (``shed_infeasible``) — the estimate is logged with the decision."""
+        self.shed_infeasible += 1
+        self._emit("shed", request_id=request_id, deadline_s=round(deadline_s, 6),
+                   estimate_s=round(estimate_s, 6))
+
+    def record_breaker(self, replica: int, old: str, new: str, tick: int) -> None:
+        key = f"{old}->{new}"
+        self.breaker_transitions[key] = self.breaker_transitions.get(key, 0) + 1
+        self._emit("breaker", replica=replica, transition=key, tick=tick)
+
+    def record_finish(self, request_id: int, status: str, reason: Optional[str],
+                      new_tokens: int, failovers: int) -> None:
+        """Terminal router-level outcome (counter routing shared with the
+        engine via ``_route_status``; rejected here covers queue/shed/drain
+        refusals)."""
+        self._route_status(status)
+        self._emit("finish", request_id=request_id, status=status, reason=reason,
+                   new_tokens=new_tokens, failovers=failovers)
+
+    # ---------------------------------------------------------------- snapshot
+    def snapshot(self, replicas: Optional[Dict[str, Dict]] = None) -> Dict:
+        """Router snapshot: router-level counters plus aggregates over the
+        per-replica engine snapshots handed in (tokens are generated by
+        engines — the router only aggregates; wall-clock is the honest
+        denominator because replica decode windows overlap)."""
+        wall = (time.perf_counter() - self._start_time) if self._start_time else 0.0
+        replicas = replicas or {}
+        tokens = sum(s.get("tokens_generated", 0) for s in replicas.values())
+        snap = {
+            "schema": SCHEMA,
+            "num_replicas": self.num_replicas,
+            "requests_submitted": self.requests_submitted,
+            "requests_dispatched": self.requests_dispatched,
+            "requests_finished": self.requests_finished,
+            "rejected": self.requests_rejected,
+            "timed_out": self.requests_timed_out,
+            "failed": self.requests_failed,
+            "failovers": self.failovers,
+            "shed_infeasible": self.shed_infeasible,
+            "breaker_transitions": dict(sorted(self.breaker_transitions.items())),
+            "tokens_generated": tokens,
+            "wall_seconds": round(wall, 6),
+            "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,
+            "replicas": replicas,
+        }
+        return snap
+
+    def write_snapshot(self, replicas: Optional[Dict[str, Dict]] = None) -> Dict:
+        snap = self.snapshot(replicas)
+        self._emit("snapshot", **snap)
+        return snap

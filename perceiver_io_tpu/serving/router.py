@@ -1,0 +1,882 @@
+"""Fault-tolerant multi-replica serving: a health-checked front-end router.
+
+A single ``ServingEngine`` is a single failure domain: one crashed, stalled,
+or NaN-poisoned engine takes every queued and running session with it.
+Production TPU serving runs MANY engine replicas behind a front end (cf. the
+Gemma-on-TPU serving comparison in PAPERS.md); ``ServingRouter`` is that
+layer, built entirely from primitives the stack already proves out —
+deterministic fault points (reliability/faults.py), bounded deterministic
+backoff (reliability/retry.py), per-request deadlines and windowed p95
+latency metrics (serving/metrics.py), and per-replica telemetry namespaces
+(obs/). See docs/serving.md ("Multi-replica router") and
+docs/reliability.md for the full contracts.
+
+Design:
+
+  * **Same surface as the engine.** ``submit()`` returns a handle
+    immediately, ``step()`` runs one router tick, ``run_until_drained()`` /
+    ``drain()`` close the loop — a caller written against ``ServingEngine``
+    moves to N replicas by swapping the constructor.
+  * **Dispatch by live load.** A new request goes to the least-loaded replica
+    whose circuit breaker is CLOSED — load is ``SlotScheduler.load``
+    (queue depth beyond free capacity, the same number the engine's own
+    queue bound ranks on), ties break on the lowest replica index, so
+    placement is deterministic given the submit/tick interleaving.
+  * **Per-replica health + circuit breaker.** Health is tracked from tick
+    heartbeats (a replica's tick ran this round), consecutive tick
+    exceptions, slow-tick strikes (measured tick duration beyond
+    ``slow_tick_threshold_s`` — the wedged-engine detector), and the
+    NaN-containment count harvested from the replica's own metrics. A
+    breaker runs CLOSED -> OPEN -> HALF_OPEN: OPEN replicas are not ticked
+    and receive no work for a cooldown counted in ROUTER TICKS — the
+    bounded-exponential schedule of ``reliability/retry.py`` with jitter 0,
+    so like the fault registry there are no clocks and no randomness in the
+    decision; then HALF_OPEN admits exactly one probe tick, closing on
+    success (stale slots reclaimed first) and re-opening with a doubled
+    cooldown on failure.
+  * **Deterministic failover.** When a replica is lost, each of its queued
+    and running requests is re-dispatched to a healthy replica as
+    ``prompt + already-emitted tokens``: the new engine prefills the prompt
+    exactly as the lost one did (same covering bucket — the parity-pinned
+    admission path), then REPLAYS the emitted tokens through its compiled
+    decode step as forced tokens, reconstructing the lost engine's decode
+    trajectory — ring rotation, logits, and rng chain included — step for
+    step. The continuation is therefore token-identical to the
+    uninterrupted run (pinned in float64; even sampled requests continue
+    identically, because the per-slot key chain re-advances through the
+    replay). A naive re-prefill of prompt+tokens would NOT be equivalent:
+    Perceiver AR's latent/prefix split at a position depends on how the
+    state was built, not just which tokens are live. Each request survives
+    at most ``max_failovers`` re-dispatches before terminating FAILED with
+    its partial output preserved, the way TIMED_OUT eviction already
+    preserves it.
+  * **SLO-aware shedding.** A deadlined request is REJECTED at admission
+    (``shed_infeasible``) when the windowed p95 queue-wait + prefill +
+    ``max_new_tokens`` x p95 decode-step estimate — PR 2's metrics — says
+    the deadline cannot be met on ANY healthy replica: under overload the
+    router degrades by refusing doomed work instead of queueing it. Cold
+    replicas (fewer than ``shed_min_samples`` decode steps) never shed.
+  * **No request is silently lost.** Every submitted handle reaches an
+    explicit terminal status — FINISHED, REJECTED (queue/shed/drain),
+    TIMED_OUT, or FAILED (containment, ``max_failovers``) — while any
+    replica still serves; ``drain()`` and the SIGTERM/SIGINT graceful path
+    resolve the backlog explicitly. The one deliberate wait: a request with
+    NO deadline parked during a FULL fleet outage stays QUEUED until a
+    replica recovers or ``drain()`` rejects it — give requests deadlines (or
+    set ``max_queue_depth``) when unbounded waiting is unacceptable, and
+    pass ``max_steps`` to the drain loops as the last-resort guard.
+
+Observability: the router resolves ONE recorder and shares it with every
+replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
+and the engines' collision-safe per-engine request categories, plus its own
+``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
+phase tables from the single trace. Metrics are ``serving-metrics/v4``:
+router snapshots embed per-replica engine snapshots and the
+failover/shed/breaker counters.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from perceiver_io_tpu.generation.generate import GenerationConfig
+from perceiver_io_tpu.obs.core import resolve_recorder
+from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.preemption import (
+    install_preemption_handler,
+    restore_preemption_handler,
+)
+from perceiver_io_tpu.reliability.retry import RetryPolicy
+from perceiver_io_tpu.serving.engine import (
+    RequestStatus,
+    ServedRequest,
+    ServingEngine,
+    _engine_compatible,
+)
+from perceiver_io_tpu.serving.metrics import RouterMetrics
+
+# breaker states (str values land in metrics transition keys and trace events)
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+@dataclass
+class RoutedRequest:
+    """Router-level handle returned by ``ServingRouter.submit``.
+
+    Mirrors the ``ServedRequest`` surface (``status``/``ok``/``done``/
+    ``finish_reason``/``result()``) but survives the engine that currently
+    runs it: tokens emitted before a replica was lost are kept in
+    ``_salvaged`` and the continuation decodes on another replica, so
+    ``result()`` is always the full stream and ``output_ids`` never moves
+    backwards while the replacement engine replays the prefix."""
+
+    request_id: int
+    prompt_ids: np.ndarray
+    config: GenerationConfig
+    rng: object
+    finish_reason: Optional[str] = None
+    submitted_at: float = 0.0
+    finished_at: Optional[float] = None
+    deadline_s: Optional[float] = None
+    failovers: int = 0  # re-dispatches survived so far
+    replica: Optional[int] = None  # current replica index (None = unplaced)
+    # longest token prefix salvaged from any lost replica; the live engine
+    # handle overtakes it as its forced replay catches up
+    _salvaged: List[int] = field(default_factory=list, repr=False)
+    _engine_handle: Optional[ServedRequest] = field(default=None, repr=False)
+    # set once by the router's _resolve; None while the request is live
+    _terminal_status: Optional[RequestStatus] = field(default=None, repr=False)
+
+    @property
+    def status(self) -> RequestStatus:
+        """Mirrors the engine handle's surface: QUEUED (router-parked or
+        engine-queued), RUNNING (holding a slot somewhere), or the terminal
+        status the router resolved. An engine-terminal-but-unharvested handle
+        reads RUNNING for the within-tick instant before the router resolves
+        it — ``done`` flips only through the router's own bookkeeping."""
+        if self._terminal_status is not None:
+            return self._terminal_status
+        handle = self._engine_handle
+        if handle is not None:
+            if handle.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
+                return handle.status
+            return RequestStatus.RUNNING
+        return RequestStatus.QUEUED
+
+    @property
+    def done(self) -> bool:
+        return self._terminal_status is not None
+
+    @property
+    def ok(self) -> bool:
+        return self.status is RequestStatus.FINISHED
+
+    @property
+    def output_ids(self) -> List[int]:
+        """All tokens emitted so far — MONOTONIC across failover. During a
+        replay the new engine re-emits the salvaged prefix token by token;
+        until its stream overtakes the salvage, the salvage is the answer
+        (the replayed prefix is identical by construction), so a streaming
+        consumer forwarding ``out[len(sent):]`` never sees a negative
+        delta."""
+        engine_out = self._engine_handle.output_ids if self._engine_handle else []
+        if len(engine_out) >= len(self._salvaged):
+            return list(engine_out)
+        return list(self._salvaged)
+
+    @property
+    def admitted_at(self) -> Optional[float]:
+        """``time.perf_counter()`` instant this request last reached a slot
+        (None while queued/parked) — time-to-admission is the burst-capacity
+        SLO the replica-scaling bench measures."""
+        if self._engine_handle is None:
+            return None
+        return self._engine_handle.admitted_at
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
+    def result(self) -> np.ndarray:
+        """Generated tokens (prompt excluded) across every replica that served
+        this request. Partial for TIMED_OUT/FAILED — check ``ok``."""
+        return np.asarray(self.output_ids, np.int32)
+
+
+@dataclass
+class _Replica:
+    """One engine replica's router-side health record."""
+
+    rid: int
+    engine: ServingEngine
+    breaker: str = BREAKER_CLOSED
+    opened_at_tick: int = 0
+    open_count: int = 0  # consecutive opens; indexes the backoff ladder
+    cooldown_ticks: int = 0
+    consecutive_failures: int = 0  # tick exceptions since last healthy tick
+    consecutive_slow: int = 0  # slow-tick strikes since last fast tick
+    nan_failures: int = 0  # cumulative nonfinite containments harvested
+    last_tick: int = -1  # heartbeat: router tick of the last completed tick
+    last_error: Optional[str] = None
+    # engine request_id -> routed request, for every live hand-off
+    assigned: Dict[int, RoutedRequest] = field(default_factory=dict)
+    # engine request ids failed over but not yet reclaimed from the engine
+    # (the router never touches a DOWN engine; reclaim happens at recovery)
+    orphaned: set = field(default_factory=set)
+    # THIS replica's own dispatch+harvest time in the current tick — the
+    # slow-tick detector's input. Never measured across siblings: one wedged
+    # replica must not inflate a healthy neighbor's reading
+    _own_tick_s: float = 0.0
+    # engine program count at the last healthy tick: a tick that compiled
+    # something is legitimately slow and must not strike the stall detector
+    _programs_seen: int = 0
+
+
+class ServingRouter:
+    """Front-end router over ``num_replicas`` engine replicas (module
+    docstring; docs/serving.md). Same submit/step/drain surface as
+    ``ServingEngine``."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        num_replicas: int = 2,
+        num_slots: int = 4,
+        cache_dtype=None,
+        metrics_jsonl: Optional[str] = None,
+        replica_metrics_jsonl: Optional[str] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_queue_depth: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        telemetry=None,
+        handle_preemption: bool = False,
+        # failover / breaker policy (docs/reliability.md failure-domain table)
+        max_failovers: int = 2,
+        failure_threshold: int = 1,
+        slow_tick_threshold_s: Optional[float] = None,
+        slow_ticks_to_open: int = 3,
+        nan_failures_to_open: Optional[int] = 3,
+        breaker_cooldown_ticks: int = 4,
+        breaker_max_cooldown_ticks: int = 64,
+        # SLO shedding
+        shed_infeasible: bool = True,
+        shed_min_samples: int = 3,
+    ):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        if max_failovers < 0:
+            raise ValueError(f"max_failovers must be >= 0, got {max_failovers}")
+        self.model = model
+        self.num_replicas = num_replicas
+        self._window = model.max_seq_len
+        self.max_failovers = max_failovers
+        self.failure_threshold = max(failure_threshold, 1)
+        self.slow_tick_threshold_s = slow_tick_threshold_s
+        self.slow_ticks_to_open = max(slow_ticks_to_open, 1)
+        self.nan_failures_to_open = nan_failures_to_open
+        self.shed_infeasible = shed_infeasible
+        self.shed_min_samples = max(shed_min_samples, 1)
+        self.default_deadline_s = default_deadline_s
+        self.max_queue_depth = max_queue_depth
+        # cooldown ladder: reliability/retry.py's bounded-exponential schedule
+        # in TICK units with jitter 0 — cooldown(nth consecutive open) =
+        # min(max, base * 2^(n-1)) ticks. Deterministic: the rng argument is
+        # demanded by the API but jitter 0 never consults it.
+        self._breaker_policy = RetryPolicy(
+            attempts=1,
+            base_delay_s=float(max(breaker_cooldown_ticks, 1)),
+            max_delay_s=float(max(breaker_max_cooldown_ticks, breaker_cooldown_ticks, 1)),
+            jitter=0.0,
+        )
+        self._breaker_rng = random.Random(0)
+
+        # one shared recorder for the router and every replica (per-replica
+        # span namespaces keep phase tables separable; the engines' request
+        # categories are already collision-safe per engine)
+        self._obs, self._owns_telemetry = resolve_recorder(telemetry)
+        self._obs_on = self._obs.enabled
+        engine_telemetry = self._obs if self._obs_on else False
+        self.replicas: List[_Replica] = [
+            _Replica(
+                rid=i,
+                engine=ServingEngine(
+                    model, params,
+                    num_slots=num_slots,
+                    cache_dtype=cache_dtype,
+                    prefill_buckets=prefill_buckets,
+                    max_queue_depth=max_queue_depth,
+                    # per-replica engine event stream: a "{i}" placeholder in
+                    # the template keeps the streams separate per replica
+                    metrics_jsonl=replica_metrics_jsonl.format(i=i)
+                    if replica_metrics_jsonl else None,
+                    telemetry=engine_telemetry,
+                    obs_ns=f"serving.r{i}",
+                ),
+            )
+            for i in range(num_replicas)
+        ]
+        self.metrics = RouterMetrics(num_replicas=num_replicas, jsonl_path=metrics_jsonl)
+        self.finished: List[RoutedRequest] = []
+        self._ids = itertools.count()
+        self._tick = 0  # the breaker clock: cooldowns are counted in ticks
+        self._pending: Deque[RoutedRequest] = deque()  # held while no replica can accept
+        self._deadlines_seen = default_deadline_s is not None
+        self._draining = False
+        # SIGTERM/SIGINT graceful drain, same semantics as the engine's
+        self.preempted = False
+        self._preempt_requested = False
+        self._preempt_flushed = False
+        self._preempt_handler = None
+        self._preempt_previous: dict = {}
+        if handle_preemption:
+            def _request_preempt():
+                self._preempt_requested = True
+            self._preempt_handler, self._preempt_previous = (
+                install_preemption_handler(_request_preempt)
+            )
+
+    # ------------------------------------------------------------------ submit
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        config: Optional[GenerationConfig] = None,
+        rng=None,
+        deadline_s: Optional[float] = None,
+        **kwargs,
+    ) -> RoutedRequest:
+        """Queue one request; returns its router-level handle. Semantics
+        mirror ``ServingEngine.submit``: malformed requests raise, well-formed
+        requests the fleet cannot serve come back terminal in REJECTED —
+        including the router-only outcome ``shed_infeasible`` (the deadline
+        cannot be met per the live latency estimates)."""
+        if config is None:
+            config = GenerationConfig(**kwargs)
+        elif kwargs:
+            raise ValueError("pass either config or keyword options, not both")
+        reason = _engine_compatible(config)
+        if reason is not None:
+            raise ValueError(f"GenerationConfig not servable by the engine: {reason}")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must be non-empty (over-long prompts are "
+                             "REJECTED at admission, empty ones are malformed)")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        routed = RoutedRequest(
+            request_id=next(self._ids),
+            prompt_ids=prompt,
+            config=config,
+            rng=rng,
+            submitted_at=time.perf_counter(),
+            deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
+        )
+        if routed.deadline_s is not None:
+            self._deadlines_seen = True
+        self.metrics.record_submit(routed.request_id, int(prompt.size))
+        if self._obs_on:
+            self._obs.async_begin("router.request", routed.request_id,
+                                  prompt_len=int(prompt.size))
+        if self._draining:
+            return self._refuse(routed, "draining")
+        if prompt.size > self._window:
+            return self._refuse(routed, "prompt_too_long")
+        if routed.deadline_s is not None and self.shed_infeasible:
+            est = self._estimate_completion_s(config.max_new_tokens)
+            if est is not None and est > routed.deadline_s:
+                self.metrics.record_shed(routed.request_id, routed.deadline_s, est)
+                if self._obs_on:
+                    self._obs.counter_inc("router.shed_infeasible")
+                return self._refuse(routed, "shed_infeasible")
+        self._dispatch(routed)
+        return routed
+
+    def _refuse(self, routed: RoutedRequest, reason: str) -> RoutedRequest:
+        self._resolve(routed, RequestStatus.REJECTED, reason)
+        return routed
+
+    # ---------------------------------------------------------------- dispatch
+    def _serving_replicas(self) -> List[_Replica]:
+        """Replicas eligible for NEW work: breaker CLOSED, least-loaded first
+        (ties on the lowest index — deterministic placement)."""
+        eligible = [r for r in self.replicas if r.breaker == BREAKER_CLOSED]
+        return sorted(eligible, key=lambda r: (r.engine.scheduler.load, r.rid))
+
+    def _remaining_deadline(self, routed: RoutedRequest, now: float) -> Optional[float]:
+        """Deadline budget LEFT for an engine hand-off: the engine enforces
+        TTLs from ITS submit instant, so time already spent at the router
+        (queueing while all replicas were down, earlier failovers) must be
+        subtracted — a failover never extends a request's deadline."""
+        if routed.deadline_s is None:
+            return None
+        return max(routed.deadline_at - now, 0.0)
+
+    def _dispatch(self, routed: RoutedRequest, requeue: bool = False) -> bool:
+        """Place one request (fresh, or a failover continuation) on the
+        least-loaded healthy replica. Returns True when the request reached a
+        terminal or assigned state, False when it was parked in the router
+        queue. ``requeue`` marks ALREADY-ACCEPTED work (failover
+        continuations, parked retries): fresh submits that find every
+        healthy queue at its bound are terminally REJECTED/queue_full — the
+        backpressure contract — but accepted work must never be killed by a
+        momentary full queue; it parks and retries as capacity frees.
+
+        Failover continuations hand the engine the ORIGINAL prompt plus the
+        already-emitted tokens as a forced REPLAY stream: the new replica
+        prefills the prompt exactly as the lost one did (same covering
+        bucket — the parity-pinned admission path) and then replays the
+        emitted tokens through the compiled decode step, reconstructing the
+        lost engine's decode trajectory — rng chain included — step for
+        step. The continuation is therefore token-identical to the
+        uninterrupted run (pinned in f64; sampled requests too, since the
+        key chain re-advances identically), a re-prefill of prompt+tokens
+        could not be: Perceiver AR's latent/prefix split at a position
+        depends on HOW the state was built, not just which tokens are live."""
+        emitted = routed._salvaged
+        if emitted and len(emitted) >= routed.config.max_new_tokens:
+            # defensive: a continuation with nothing left to decode is a
+            # completed request (the engine evicts at the emitting tick, so
+            # this only happens if a failure landed mid-harvest)
+            self._resolve(routed, RequestStatus.FINISHED, "length")
+            return True
+        now = time.perf_counter()
+        saw_closed = False
+        for r in self._serving_replicas():
+            saw_closed = True
+            load_at_decision = r.engine.scheduler.load  # submit() bumps it
+            handle = r.engine.submit(
+                routed.prompt_ids, config=routed.config, rng=routed.rng,
+                deadline_s=self._remaining_deadline(routed, now),
+                replay_ids=emitted if emitted else None,
+            )
+            if handle.status is RequestStatus.REJECTED:
+                if handle.finish_reason == "queue_full":
+                    continue  # backpressure at this replica: try the next
+                # prompt_too_long/draining from a fresh submit are terminal
+                self._resolve(routed, RequestStatus.REJECTED, handle.finish_reason)
+                return True
+            routed._engine_handle = handle
+            routed.replica = r.rid
+            # the salvage buffer is NOT cleared: output_ids reports
+            # max(salvage, engine stream), so the view stays monotonic while
+            # the engine re-emits the replayed prefix
+            r.assigned[handle.request_id] = routed
+            self.metrics.record_dispatch(routed.request_id, r.rid,
+                                         load=load_at_decision)
+            if self._obs_on:
+                self._obs.async_instant("router.request", routed.request_id,
+                                        "dispatch", replica=r.rid,
+                                        failover_n=routed.failovers)
+            return True
+        routed.replica = None
+        if requeue:
+            # accepted work is never terminally rejected here; the CALLER
+            # re-parks it (ordering among several victims is the caller's
+            # to preserve)
+            return False
+        if saw_closed:
+            # healthy replicas exist but every queue is at its bound: the
+            # engine's own backpressure answer, surfaced unchanged
+            self._resolve(routed, RequestStatus.REJECTED, "queue_full")
+            return True
+        # no healthy replica at all: park until a breaker closes (the
+        # bound, when configured, still applies — an outage must not
+        # grow an unbounded router backlog)
+        if self.max_queue_depth is not None and len(self._pending) >= self.max_queue_depth:
+            self._resolve(routed, RequestStatus.REJECTED, "queue_full")
+            return True
+        self._pending.append(routed)
+        return False
+
+    def _dispatch_pending(self) -> None:
+        while self._pending and any(r.breaker == BREAKER_CLOSED for r in self.replicas):
+            routed = self._pending.popleft()
+            if routed.done:  # expired while parked
+                continue
+            if not self._dispatch(routed, requeue=True):
+                self._pending.appendleft(routed)  # restore its place
+                break
+
+    def _expire_pending(self, now: float) -> None:
+        """TTL enforcement for router-parked requests (engines enforce their
+        own): expiry while every replica is down must still be an explicit
+        TIMED_OUT, never a silent loss."""
+        if not self._pending:
+            return
+        kept: Deque[RoutedRequest] = deque()
+        for routed in self._pending:
+            if routed.deadline_at is not None and now >= routed.deadline_at:
+                self._resolve(routed, RequestStatus.TIMED_OUT, "deadline")
+            else:
+                kept.append(routed)
+        self._pending = kept
+
+    # ----------------------------------------------------------------- breaker
+    def _transition(self, r: _Replica, new: str) -> None:
+        old, r.breaker = r.breaker, new
+        self.metrics.record_breaker(r.rid, old, new, self._tick)
+        if self._obs_on:
+            self._obs.counter_inc(f"router.breaker.{old}->{new}")
+            self._obs.instant("router.breaker", replica=r.rid, transition=f"{old}->{new}")
+
+    def _open_breaker(self, r: _Replica, cause: str) -> None:
+        """Take a replica out of service: OPEN the breaker with the next
+        cooldown on the ladder, then fail its live requests over."""
+        if r.breaker == BREAKER_OPEN:
+            # two triggers in one tick (e.g. NaN threshold at harvest AND a
+            # slow-tick strike) must not double-open: the second would forge
+            # an open->open transition and skip a rung of the backoff ladder
+            return
+        r.open_count += 1
+        # retry.py's schedule in tick units (attempt = nth consecutive open);
+        # jitter is 0 so the rng is never consulted — no randomness in the
+        # firing decision, the faults.py discipline
+        r.cooldown_ticks = max(int(self._breaker_policy.delay(r.open_count, self._breaker_rng)), 1)
+        r.opened_at_tick = self._tick
+        r.consecutive_failures = 0
+        r.consecutive_slow = 0
+        r.last_error = cause
+        self._transition(r, BREAKER_OPEN)
+        self._failover_replica(r)
+
+    def _promote_breakers(self) -> None:
+        for r in self.replicas:
+            if (
+                r.breaker == BREAKER_OPEN
+                and self._tick - r.opened_at_tick >= r.cooldown_ticks
+            ):
+                self._transition(r, BREAKER_HALF_OPEN)
+                # reclaim the QUEUED orphans before the probe tick runs —
+                # host-only bookkeeping, so it is safe on a suspect engine,
+                # and without it the probe's admission phase would waste a
+                # prefill + slot per stale entry on requests already running
+                # elsewhere. Stale RUNNING slots wait for probe success
+                # (_recover_replica): their release touches device state we
+                # only trust after a healthy tick.
+                for engine_req_id in sorted(r.orphaned):
+                    if r.engine.evict_request(engine_req_id, "replica_failover",
+                                              status=RequestStatus.FAILED,
+                                              queued_only=True):
+                        r.orphaned.discard(engine_req_id)
+
+    def _on_tick_failure(self, r: _Replica, exc: BaseException) -> None:
+        r.consecutive_failures += 1
+        r.last_error = f"{type(exc).__name__}: {exc}"
+        if r.breaker == BREAKER_HALF_OPEN:
+            # a failed probe re-opens immediately with a longer cooldown
+            self._open_breaker(r, r.last_error)
+        elif r.consecutive_failures >= self.failure_threshold:
+            self._open_breaker(r, r.last_error)
+
+    def _on_tick_success(self, r: _Replica, duration_s: float) -> None:
+        r.last_tick = self._tick  # heartbeat
+        slow = (
+            self.slow_tick_threshold_s is not None
+            and duration_s > self.slow_tick_threshold_s
+        )
+        if slow:
+            # compile-tick exemption: first-use and new-bucket jit compiles
+            # take seconds and are NOT a wedged engine — a strike here would
+            # open breakers on every cold replica (and re-pay the same
+            # compiles on its sibling). Detected the same way the PR6
+            # watchdog counts programs: the engine's jit cache sizes moved.
+            programs = r.engine.total_compilations
+            if programs != r._programs_seen:
+                r._programs_seen = programs
+                slow = False
+        if slow:
+            r.consecutive_slow += 1
+            if r.breaker == BREAKER_HALF_OPEN:
+                # a stalled probe is a failed probe
+                self._open_breaker(r, f"slow probe tick ({duration_s:.3f}s)")
+            elif r.consecutive_slow >= self.slow_ticks_to_open:
+                self._open_breaker(r, f"{r.consecutive_slow} consecutive slow ticks")
+            return
+        r.consecutive_failures = 0
+        r.consecutive_slow = 0
+        if r.breaker == BREAKER_HALF_OPEN:
+            self._recover_replica(r)
+
+    def _recover_replica(self, r: _Replica) -> None:
+        """A HALF_OPEN probe tick succeeded: reclaim the stale state the
+        replica held when it went down — orphaned slots are evicted through
+        the engine's own API (their requests moved on at failover; the
+        handles are terminal bookkeeping) — and close the breaker. The
+        backoff ladder resets: a recovered replica earns the base cooldown
+        again."""
+        r.engine.discard_pending_harvest()
+        for engine_req_id in sorted(r.orphaned):
+            r.engine.evict_request(engine_req_id, "replica_failover",
+                                   status=RequestStatus.FAILED)
+        r.orphaned.clear()
+        # drop the orphaned terminal handles (and any pre-crash finished ones
+        # whose routed requests were failed over): nothing maps to them now
+        r.engine.finished = [h for h in r.engine.finished
+                             if h.request_id in r.assigned]
+        r.open_count = 0
+        r.nan_failures = 0
+        self._transition(r, BREAKER_CLOSED)
+
+    # ---------------------------------------------------------------- failover
+    def _failover_replica(self, r: _Replica) -> None:
+        """Re-dispatch every live request of a lost replica. The dead engine
+        is NOT touched (a real crash leaves nothing to call into) — its
+        stale slots are reclaimed if/when the replica recovers."""
+        victims = sorted(r.assigned.items())  # engine request_id order = admission order
+        r.assigned.clear()
+        parked: List[RoutedRequest] = []
+        for engine_req_id, routed in victims:
+            handle = routed._engine_handle
+            if handle is not None and handle.done:
+                # terminal at the engine but unharvested (failure landed
+                # between evict and harvest): the outcome stands
+                self._resolve(routed, handle.status, handle.finish_reason)
+                continue
+            r.orphaned.add(engine_req_id)
+            # keep the LONGEST prefix seen: a crash mid-replay hands back a
+            # handle shorter than the salvage it was rebuilding
+            salvaged = list(handle.output_ids) if handle is not None else []
+            if len(salvaged) > len(routed._salvaged):
+                routed._salvaged = salvaged
+            routed._engine_handle = None
+            routed.replica = None
+            routed.failovers += 1
+            self.metrics.record_failover(routed.request_id, r.rid,
+                                         emitted_tokens=len(routed._salvaged),
+                                         failover_n=routed.failovers)
+            if self._obs_on:
+                self._obs.counter_inc("router.failovers")
+                self._obs.async_instant("router.request", routed.request_id,
+                                        "failover", from_replica=r.rid,
+                                        emitted=len(routed._salvaged))
+            if routed.failovers > self.max_failovers:
+                self._resolve(routed, RequestStatus.FAILED, "max_failovers")
+                continue
+            if not self._dispatch(routed, requeue=True):
+                parked.append(routed)
+        if parked:
+            # continuations park at the FRONT of the router queue (they are
+            # older than anything a fresh submit parked behind them), in
+            # admission order among themselves — extendleft reverses, so
+            # feed it the reversed list
+            self._pending.extendleft(reversed(parked))
+
+    # ----------------------------------------------------------------- harvest
+    def _harvest_finished(self, r: _Replica) -> None:
+        nan_hits = 0
+        for handle in r.engine.finished:
+            routed = r.assigned.pop(handle.request_id, None)
+            if handle.finish_reason == "nonfinite_logits":
+                nan_hits += 1
+            if routed is None:
+                continue  # orphan bookkeeping or warmup traffic: not ours
+            self._resolve(routed, handle.status, handle.finish_reason)
+        r.engine.finished.clear()
+        if nan_hits:
+            r.nan_failures += nan_hits
+            if (
+                self.nan_failures_to_open is not None
+                and r.breaker == BREAKER_CLOSED
+                and r.nan_failures >= self.nan_failures_to_open
+            ):
+                # a replica repeatedly producing non-finite logits is sick
+                # (bad memory, corrupt weights) — stop feeding it. The count
+                # stays visible on snapshots while the breaker is OPEN (an
+                # operator inspecting a sick replica needs the WHY); recovery
+                # resets it.
+                self._open_breaker(r, f"{r.nan_failures} NaN containments")
+
+    def _resolve(self, routed: RoutedRequest, status: RequestStatus,
+                 reason: Optional[str]) -> None:
+        """The ONE terminal-bookkeeping path: submit-time refusals, dispatch
+        rejections, harvest outcomes, failover exhaustion, and drain all land
+        here, so counters, JSONL, and trace spans can never diverge."""
+        routed._terminal_status = status
+        routed.finish_reason = reason
+        routed.finished_at = time.perf_counter()
+        self.finished.append(routed)
+        self.metrics.record_finish(
+            routed.request_id, status.value, reason,
+            new_tokens=len(routed.output_ids), failovers=routed.failovers,
+        )
+        if self._obs_on:
+            if status is RequestStatus.REJECTED:
+                self._obs.counter_inc("router.rejected")
+            self._obs.async_end("router.request", routed.request_id,
+                                status=status.value, reason=reason,
+                                new_tokens=len(routed.output_ids),
+                                failovers=routed.failovers)
+
+    # -------------------------------------------------------------------- step
+    @property
+    def has_work(self) -> bool:
+        """True while any non-terminal request can still make progress:
+        parked requests, live hand-offs, or engine-side work on replicas the
+        router still ticks. A permanently-OPEN replica's stale slots do NOT
+        count — their requests already moved on."""
+        return (
+            bool(self._pending)
+            or any(r.assigned for r in self.replicas)
+            or any(
+                r.breaker != BREAKER_OPEN and r.engine.scheduler.has_work
+                for r in self.replicas
+            )
+        )
+
+    def step(self) -> bool:
+        """One router tick: promote breakers, place parked work, then tick
+        every serving replica in two phases — DISPATCH all (each replica's
+        decode starts on-device), then HARVEST all (sync + evict) — so one
+        replica's device step overlaps its siblings' host work. Returns True
+        while work remains anywhere in the fleet."""
+        if self._preempt_requested and not self._draining:
+            self.preempted = True
+            self._begin_drain()
+        self._tick += 1
+        with self._obs.span("router.tick"):
+            now = time.perf_counter()
+            if self._deadlines_seen:
+                self._expire_pending(now)
+            self._promote_breakers()
+            self._dispatch_pending()
+            # CLOSED replicas serve; HALF_OPEN replicas always get their probe
+            # tick (even idle — an un-probed idle replica would never close)
+            ticking = [r for r in self.replicas if r.breaker != BREAKER_OPEN]
+            dispatched: List[_Replica] = []
+            for r in ticking:
+                try:
+                    t0 = time.perf_counter()
+                    faults.fire_replica_tick(r.rid)
+                    r.engine.step_dispatch()
+                    r._own_tick_s = time.perf_counter() - t0
+                    dispatched.append(r)
+                except Exception as e:  # noqa: BLE001 — replica loss IS the domain
+                    self._on_tick_failure(r, e)
+            for r in dispatched:
+                try:
+                    t0 = time.perf_counter()
+                    r.engine.step_harvest()
+                    r._own_tick_s += time.perf_counter() - t0
+                except Exception as e:  # noqa: BLE001
+                    self._on_tick_failure(r, e)
+                    continue
+                self._harvest_finished(r)
+                self._on_tick_success(r, r._own_tick_s)
+            if self._obs_on:
+                self._obs.gauge_set("router.pending", len(self._pending))
+                self._obs.gauge_set(
+                    "router.replicas_closed",
+                    sum(1 for r in self.replicas if r.breaker == BREAKER_CLOSED),
+                )
+        has_work = self.has_work
+        self._maybe_flush_preempted(has_work)
+        return has_work
+
+    def run_until_drained(self, max_steps: Optional[int] = None) -> List[RoutedRequest]:
+        """Step until every submitted request reached a terminal status;
+        returns (and drains) the requests finished since the last drain."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(f"router not drained after {max_steps} steps")
+        drained, self.finished = self.finished, []
+        return drained
+
+    def _begin_drain(self) -> None:
+        """Close admission fleet-wide: reject the router-parked backlog and
+        every replica's queued backlog; active slots keep decoding."""
+        self._draining = True
+        while self._pending:
+            routed = self._pending.popleft()
+            self._resolve(routed, RequestStatus.REJECTED, "draining")
+        for r in self.replicas:
+            if r.breaker == BREAKER_OPEN:
+                continue  # nothing to reject; its requests already moved on
+            r.engine._begin_drain()
+
+    def drain(self, max_steps: Optional[int] = None) -> List[RoutedRequest]:
+        """Graceful fleet shutdown: refuse new work, reject all backlogs,
+        finish every active slot. Returns the drained terminal handles."""
+        self._begin_drain()
+        return self.run_until_drained(max_steps=max_steps)
+
+    def _maybe_flush_preempted(self, has_work: bool) -> None:
+        if self.preempted and not self._preempt_flushed and not has_work:
+            self._preempt_flushed = True
+            self.write_snapshot()
+            self.close()
+
+    # --------------------------------------------------------------- shedding
+    def _estimate_completion_s(self, max_new_tokens: int) -> Optional[float]:
+        """Best completion-time estimate across healthy replicas, from the
+        windowed p95 latency stats PR 2's metrics already maintain:
+        ``p95(queue wait) + p95(prefill dispatch) + max_new * p95(decode
+        step)``. None while every healthy replica is cold (< shed_min_samples
+        decode steps) — a cold fleet must never shed."""
+        best = None
+        for r in self.replicas:
+            if r.breaker != BREAKER_CLOSED:
+                continue
+            est = r.engine.metrics.latency_estimates()
+            if est is None or est["decode_steps"] < self.shed_min_samples:
+                continue
+            total = (
+                est["queue_wait_p95_s"]
+                + est["prefill_p95_s"]
+                + max_new_tokens * est["decode_step_p95_s"]
+            )
+            if best is None or total < best:
+                best = total
+        return best
+
+    # -------------------------------------------------------------- telemetry
+    @property
+    def telemetry(self):
+        return self._obs
+
+    def snapshot(self) -> Dict:
+        """serving-metrics/v4 router snapshot with per-replica sections."""
+        return self.metrics.snapshot(self._replica_snapshots())
+
+    def write_snapshot(self) -> Dict:
+        return self.metrics.write_snapshot(self._replica_snapshots())
+
+    def _replica_snapshots(self) -> Dict[str, Dict]:
+        out = {}
+        for r in self.replicas:
+            snap = r.engine.metrics.snapshot()
+            snap["breaker"] = r.breaker
+            snap["last_tick"] = r.last_tick
+            snap["nan_failures"] = r.nan_failures
+            if r.last_error:
+                snap["last_error"] = r.last_error
+            out[f"r{r.rid}"] = snap
+        return out
+
+    def telemetry_summary(self) -> Optional[Dict]:
+        """Shared-recorder summary plus the merged per-replica compile report
+        (watch names are namespace-prefixed, so merging never collides)."""
+        if not self._obs_on:
+            return None
+        out = self._obs.summary()
+        per_fn: Dict = {}
+        unexpected: List = []
+        backend = 0
+        for r in self.replicas:
+            if r.engine.watchdog is None:
+                continue
+            s = r.engine.watchdog.summary()
+            per_fn.update(s["per_function"])
+            unexpected.extend(s["unexpected"])
+            backend = max(backend, s.get("backend_compiles", 0))
+        out["compile"] = {
+            "per_function": per_fn,
+            "backend_compiles": backend,
+            "unexpected": unexpected,
+        }
+        return out
+
+    def close(self) -> None:
+        """Release every replica's observability resources, the router's
+        metrics handle, and — when the router created the shared recorder —
+        the recorder itself. Idempotent."""
+        restore_preemption_handler(self._preempt_handler, self._preempt_previous)
+        self._preempt_handler = None
+        for r in self.replicas:
+            r.engine.close()
+        self.metrics.close()
+        if self._owns_telemetry:
+            self._obs.close()

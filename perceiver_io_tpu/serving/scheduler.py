@@ -55,6 +55,14 @@ class SlotScheduler(Generic[T]):
     def has_work(self) -> bool:
         return bool(self._queue) or self.active_slots > 0
 
+    @property
+    def load(self) -> int:
+        """Backlog beyond free capacity: ``queue_depth - free_slots``. Negative
+        = idle headroom. The engine's queue bound and the router's
+        least-loaded dispatch (serving/router.py) both rank on this number, so
+        "how full is this pool" has exactly one definition."""
+        return len(self._queue) - len(self._free)
+
     def occupant(self, slot: int) -> Optional[T]:
         return self._slots[slot]
 

@@ -56,8 +56,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, Optional, Tuple
@@ -77,6 +75,10 @@ from perceiver_io_tpu.parallel.api import (
 )
 from perceiver_io_tpu.parallel.mesh import make_mesh
 from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.preemption import (
+    install_preemption_handler,
+    restore_preemption_handler,
+)
 from perceiver_io_tpu.training.checkpoint import (
     AsyncCheckpointWriter,
     restore_checkpoint,
@@ -198,23 +200,20 @@ class Trainer:
         if self._metrics_writer is not None:
             self._metrics_writer.close()
 
-    def _install_preemption_handler(self) -> Tuple[Callable, dict]:
-        """Install the once-only SIGTERM/SIGINT graceful-stop handler (main
-        thread only — the only place CPython delivers signals). The handler
-        sets a flag the step loop polls at step boundaries AND restores the
-        previous handlers, so a second signal is forceful, not swallowed.
-        Returns (handler, previous-handlers) for symmetric restore."""
-        previous: dict = {}
+    def _install_preemption_handler(self) -> Tuple[Optional[Callable], dict]:
+        """Install the once-only SIGTERM/SIGINT graceful-stop handler (shared
+        implementation in reliability/preemption.py — the serving engine and
+        router use the same one). The handler sets a flag the step loop polls
+        at step boundaries AND restores the previous handlers, so a second
+        signal is forceful, not swallowed. Returns (handler,
+        previous-handlers) for symmetric restore."""
+        if not self.config.handle_preemption:
+            return None, {}
 
-        def on_preempt(signum, frame):
+        def _flag():
             self._preempt_requested = True
-            for s, h in previous.items():
-                signal.signal(s, h)
 
-        if self.config.handle_preemption and threading.current_thread() is threading.main_thread():
-            for s in (signal.SIGTERM, signal.SIGINT):
-                previous[s] = signal.signal(s, on_preempt)
-        return on_preempt, previous
+        return install_preemption_handler(_flag)
 
     def fit(
         self,
@@ -478,9 +477,7 @@ class Trainer:
         finally:
             # hand the signals back first (only where OUR handler is still
             # installed — the once-only handler swaps itself out on first fire)
-            for s, h in prev_handlers.items():
-                if signal.getsignal(s) is on_preempt:
-                    signal.signal(s, h)
+            restore_preemption_handler(on_preempt, prev_handlers)
             # threads must ALWAYS join — normal completion, max_steps break,
             # preemption, and exceptions anywhere in the loop alike
             for src in (epoch_source, first_source):

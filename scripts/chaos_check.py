@@ -30,6 +30,25 @@ exercised on every change, not just when production finds them:
                            backpressure counters; ``drain()`` finishes active
                            slots and refuses new work
 
+Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
+
+  * ``router_crash_failover`` a replica crashed mid-decode loses nothing:
+                           the victim's continuation is f64 token-identical
+                           to the fault-free run after failover (prefill +
+                           forced replay), survivors on healthy replicas are
+                           bit-identical throughout, every request reaches a
+                           terminal status
+  * ``router_stall_breaker`` a stalled replica trips the slow-tick detector:
+                           breaker CLOSED -> OPEN (requests failed over) ->
+                           tick-counted cooldown -> HALF_OPEN probe ->
+                           CLOSED; the recovered replica serves again
+  * ``router_shed_overload`` under overload, a deadline the windowed latency
+                           estimates say is infeasible is shed at admission
+                           (REJECTED/shed_infeasible) instead of queueing
+                           doomed work; feasible requests still complete
+  * ``router_drain``       fleet drain rejects every backlog, finishes every
+                           active slot, and keeps admission closed
+
 Every scenario is deterministic: fault firing is counter-based (no clocks, no
 randomness — reliability/faults.py), model/workload seeds are fixed, so a
 failure here reproduces exactly.
@@ -46,6 +65,7 @@ import shutil
 import sys
 import tempfile
 import time
+from contextlib import contextmanager
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -62,7 +82,7 @@ from perceiver_io_tpu.reliability.faults import FAULTS, KilledMidWrite
 # --------------------------------------------------------------- tiny fixtures
 
 
-def _serving_setup():
+def _serving_setup(param_dtype=None):
     """One tiny CausalSequenceModel shared by every serving check."""
     from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
     from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
@@ -71,12 +91,25 @@ def _serving_setup():
         vocab_size=60, max_seq_len=12, max_latents=6, num_channels=16,
         num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
     )
-    model = CausalSequenceModel(config=config)
+    kw = {} if param_dtype is None else {"param_dtype": param_dtype}
+    model = CausalSequenceModel(config=config, **kw)
     rng = jax.random.PRNGKey(0)
     params = jax.jit(model.init, static_argnames="prefix_len")(
         rng, jax.random.randint(rng, (1, 8), 0, 60), prefix_len=2
     )
     return model, params
+
+
+@contextmanager
+def _x64():
+    """Enable float64 for the duration of a parity-pinned scenario (the
+    token-identity claims are only EXACT where float equality is exact)."""
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
 
 
 def _engine(model, params, **kwargs):
@@ -327,6 +360,152 @@ def check_queue_bound() -> dict:
     }
 
 
+def check_router_crash_failover() -> dict:
+    """A replica crashed mid-decode loses nothing: the victim finishes
+    token-identical (f64) to the fault-free run after failover, the survivor
+    on the healthy replica is bit-identical throughout, and every submitted
+    request reaches a terminal status."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    with _x64():
+        import jax.numpy as jnp
+
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        ref_v = _greedy_tokens(_engine(model, params, num_slots=1), [[1, 2, 3]], max_new=6)[0]
+        ref_s = _greedy_tokens(_engine(model, params, num_slots=1), [[4, 5, 6]], max_new=6)[0]
+
+        router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                               breaker_cooldown_ticks=2)
+        victim = router.submit([1, 2, 3], max_new_tokens=6)
+        survivor = router.submit([4, 5, 6], max_new_tokens=6)
+        router.step()
+        router.step()  # two tokens decoded on each replica: crash is MID-decode
+        with armed("replica.crash", slot=victim.replica, times=1):
+            router.run_until_drained(max_steps=300)
+        snap = router.snapshot()
+        router.close()
+    victim_identical = victim.result().tolist() == ref_v.result().tolist()
+    survivor_identical = survivor.result().tolist() == ref_s.result().tolist()
+    accounted = (
+        snap["requests_submitted"]
+        == snap["requests_finished"] + snap["rejected"] + snap["timed_out"] + snap["failed"]
+    )
+    return {
+        "ok": (
+            victim.ok and victim.failovers == 1 and victim_identical
+            and survivor.ok and survivor.failovers == 0 and survivor_identical
+            and snap["failovers"] == 1
+            and snap["breaker_transitions"].get("closed->open") == 1
+            and accounted
+        ),
+        "victim_identical_after_failover": victim_identical,
+        "survivor_bit_identical": survivor_identical,
+        "failovers": snap["failovers"],
+        "no_request_lost": accounted,
+    }
+
+
+def check_router_stall_breaker() -> dict:
+    """A stalled replica trips the slow-tick detector: breaker opens (its
+    requests fail over), cooldown elapses in ticks, the HALF_OPEN probe
+    closes it, and the recovered replica serves new work."""
+    from perceiver_io_tpu.serving import ServingRouter
+    from perceiver_io_tpu.serving.router import BREAKER_CLOSED
+
+    model, params = _serving_setup()
+    router = ServingRouter(
+        model, params, num_replicas=2, num_slots=1,
+        slow_tick_threshold_s=0.25, slow_ticks_to_open=2, breaker_cooldown_ticks=2,
+    )
+    warm = [router.submit([1, 2], max_new_tokens=1) for _ in range(2)]
+    router.run_until_drained(max_steps=20)  # compile ticks: exempt, no strikes
+    victim = router.submit([1, 2, 3], max_new_tokens=10)
+    survivor = router.submit([4, 5, 6], max_new_tokens=10)
+    router.step()
+    with armed("replica.stall", slot=victim.replica, times=2, value=0.4):
+        router.step()
+        router.step()  # second strike opens the breaker, victim fails over
+    router.run_until_drained(max_steps=300)
+    recovered = router.submit([7, 8], max_new_tokens=2)
+    router.run_until_drained(max_steps=50)
+    snap = router.snapshot()
+    trans = snap["breaker_transitions"]
+    all_closed = all(r.breaker == BREAKER_CLOSED for r in router.replicas)
+    router.close()
+    return {
+        "ok": (
+            all(h.ok for h in warm)
+            and victim.ok and victim.failovers == 1 and len(victim.output_ids) == 10
+            and survivor.ok and survivor.failovers == 0
+            and trans.get("closed->open") == 1
+            and trans.get("open->half_open") == 1
+            and trans.get("half_open->closed") == 1
+            and all_closed and recovered.ok
+        ),
+        "transitions": trans,
+        "victim_failovers": victim.failovers,
+        "recovered_serves_again": recovered.ok,
+    }
+
+
+def check_router_shed_overload() -> dict:
+    """Under overload (slow ticks, deep queue-wait history), a deadline the
+    windowed p95 estimates call infeasible is shed at admission instead of
+    queueing doomed work; feasible requests still complete."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    model, params = _serving_setup()
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           shed_min_samples=1)
+    with armed("replica.slow_tick", times=None, value=0.05):
+        backlog = [router.submit([1, 2], max_new_tokens=6) for _ in range(4)]
+        router.run_until_drained(max_steps=300)  # serial drain builds real queue waits
+    doomed = router.submit([5, 6], max_new_tokens=6, deadline_s=0.001)
+    feasible = router.submit([7, 8], max_new_tokens=2, deadline_s=120.0)
+    router.run_until_drained(max_steps=100)
+    snap = router.snapshot()
+    router.close()
+    return {
+        "ok": (
+            all(h.ok for h in backlog)
+            and doomed.finish_reason == "shed_infeasible" and not doomed.ok
+            and feasible.ok
+            and snap["shed_infeasible"] == 1 and snap["rejected"] == 1
+        ),
+        "shed_reason": doomed.finish_reason,
+        "feasible_completed": feasible.ok,
+        "shed_counter": snap["shed_infeasible"],
+    }
+
+
+def check_router_drain() -> dict:
+    """Fleet drain: every backlog rejected, every active slot finished,
+    admission closed for good."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    model, params = _serving_setup()
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1)
+    active = [router.submit([1, 2], max_new_tokens=4) for _ in range(2)]
+    router.step()  # one per replica, both admitted
+    backlog = router.submit([3, 4], max_new_tokens=2)
+    drained = router.drain(max_steps=200)
+    post = router.submit([5, 6], max_new_tokens=2)
+    snap = router.snapshot()
+    router.close()
+    return {
+        "ok": (
+            all(h.ok and len(h.output_ids) == 4 for h in active)
+            and backlog.finish_reason == "draining"
+            and post.finish_reason == "draining"
+            and len(drained) == 3
+            and snap["rejected"] == 2
+            and snap["requests_finished"] == 2
+        ),
+        "reasons": [backlog.finish_reason, post.finish_reason],
+        "drained": len(drained),
+    }
+
+
 CHECKS = {
     "no_fault_inert": check_no_fault_inert,
     "flaky_loader": check_flaky_loader,
@@ -337,6 +516,10 @@ CHECKS = {
     "serving_deadline": check_serving_deadline,
     "serving_nan": check_serving_nan,
     "queue_bound": check_queue_bound,
+    "router_crash_failover": check_router_crash_failover,
+    "router_stall_breaker": check_router_stall_breaker,
+    "router_shed_overload": check_router_shed_overload,
+    "router_drain": check_router_drain,
 }
 
 

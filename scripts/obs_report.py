@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Optional
 
@@ -57,6 +58,37 @@ def phase_table(phases: Dict[str, Dict], title: str) -> List[str]:
             f"{p.get('p95_s', 0.0) * 1e3:>8.3f} {p.get('max_s', 0.0) * 1e3:>8.3f} "
             f"{share:>6.1%}"
         )
+    return lines
+
+
+_REPLICA_NS = re.compile(r"^(serving\.r\d+)\.")
+
+
+def split_replica_phases(phases: Dict[str, Dict]) -> Dict[str, Dict[str, Dict]]:
+    """Group phase names by replica namespace (``serving.rN.*`` — the span
+    prefixes a ServingRouter gives its engines on ONE shared recorder) so a
+    multi-replica trace renders one phase table per replica. Everything else
+    (router spans, training phases, plain ``serving.*`` engines) lands under
+    the ``""`` key — the shared table."""
+    groups: Dict[str, Dict[str, Dict]] = {}
+    for name, p in phases.items():
+        m = _REPLICA_NS.match(name)
+        groups.setdefault(m.group(1) if m else "", {})[name] = p
+    return groups
+
+
+def replica_phase_tables(phases: Dict[str, Dict], source: str) -> List[str]:
+    """Aligned tables for one phase dict: the shared table first, then one
+    per replica namespace when the trace came from a router fleet."""
+    groups = split_replica_phases(phases)
+    lines: List[str] = []
+    shared = groups.pop("", {})
+    if shared or not groups:
+        lines += phase_table(shared if groups else phases,
+                             f"phase breakdown — {source}")
+    for ns in sorted(groups):
+        lines.append("")
+        lines += phase_table(groups[ns], f"phase breakdown — {source} [{ns}]")
     return lines
 
 
@@ -122,19 +154,29 @@ def report_trace(path: str) -> Dict:
     # no numeric ts are skipped — the validator already reported them
     begins = {(e.get("cat"), e.get("id")): e["ts"] for e in trace.get("traceEvents", [])
               if e.get("ph") == "b" and isinstance(e.get("ts"), (int, float))}
-    lifetimes = [
-        (e["ts"] - begins[(e.get("cat"), e.get("id"))]) / 1e6
-        for e in trace.get("traceEvents", [])
-        if e.get("ph") == "e" and isinstance(e.get("ts"), (int, float))
-        and (e.get("cat"), e.get("id")) in begins
-    ]
+    by_cat: Dict[str, List[float]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "e" or not isinstance(e.get("ts"), (int, float)):
+            continue
+        key = (e.get("cat"), e.get("id"))
+        if key in begins:
+            by_cat.setdefault(e.get("cat") or "?", []).append((e["ts"] - begins[key]) / 1e6)
+
+    def _stats(xs: List[float]) -> Dict:
+        xs = sorted(xs)
+        return {"count": len(xs), "p50": round(xs[len(xs) // 2], 6),
+                "max": round(xs[-1], 6)}
+
+    lifetimes = [d for durs in by_cat.values() for d in durs]
     if lifetimes:
-        lifetimes.sort()
-        out["request_lifetimes_s"] = {
-            "count": len(lifetimes),
-            "p50": round(lifetimes[len(lifetimes) // 2], 6),
-            "max": round(lifetimes[-1], 6),
-        }
+        out["request_lifetimes_s"] = _stats(lifetimes)
+        if len(by_cat) > 1:
+            # per-category breakdown: each engine owns a collision-safe
+            # ``request.eN`` namespace, so a router fleet's shared trace
+            # splits into per-replica request-lifetime stats here
+            out["request_lifetimes_by_cat"] = {
+                cat: _stats(durs) for cat, durs in sorted(by_cat.items())
+            }
     return out
 
 
@@ -208,7 +250,7 @@ def main(argv=None) -> Dict:
             print(f"\n== {src}: {section['error']}")
             continue
         print()
-        for line in phase_table(section.get("phases", {}), f"phase breakdown — {src}"):
+        for line in replica_phase_tables(section.get("phases", {}), src):
             print(line)
         if section.get("counters") or section.get("gauges"):
             print("counters:", json.dumps(section.get("counters", {})))
@@ -219,6 +261,8 @@ def main(argv=None) -> Dict:
                 print(line)
         if section.get("request_lifetimes_s"):
             print("request lifetimes:", json.dumps(section["request_lifetimes_s"]))
+        for cat, stats in (section.get("request_lifetimes_by_cat") or {}).items():
+            print(f"  [{cat}]", json.dumps(stats))
         problems = section.get("validation_problems")
         if problems:
             print(f"!! trace validation problems ({len(problems)}):")

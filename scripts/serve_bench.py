@@ -15,6 +15,14 @@ admission arms drain ``max_new_tokens=1`` workloads (wall time is
 prefill-dominated); the decode arms drain long generations and report the
 metrics snapshot's ``decode_tokens_per_s``.
 
+``--replicas N`` runs the replica-scaling arm (ROADMAP item 2): a burst
+workload through a 1-replica and an N-replica ``ServingRouter`` (interleaved,
+median-of-``--replica-repeats``), reporting aggregate admission tokens/s
+(time until the burst's last admission — the capacity dimension replicas
+add) and drain tokens/s, with the v4 shed/failover counters; the block is
+merged into the ``--profile-out`` artifact (BENCH_serving.json) with its run
+manifest.
+
 Runs anywhere: ``JAX_PLATFORMS=cpu python scripts/serve_bench.py --preset tiny``
 finishes in under a minute and is what tests/test_serving.py smoke-drives.
 The ``bench`` preset uses the shared 30M-class decode shape (bench.py's
@@ -156,6 +164,106 @@ def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool
     return result
 
 
+def run_replica_scaling(model, params, requests, num_replicas: int,
+                        num_slots: int, repeats: int = 3) -> dict:
+    """ROADMAP item 2's bench target: aggregate ADMISSION tokens/s scaling
+    with replica count. A burst of ``len(requests)`` requests (sized ~6x one
+    replica's slots) hits a 1-replica router and an N-replica router
+    (``num_slots`` each); the admission wall is the time until the LAST
+    request reaches a slot. One replica admits ``num_slots`` immediately and
+    the rest wait whole generation waves for slots to free; N replicas hold
+    N x slots in flight, so the burst admits in a fraction of the waves —
+    the capacity dimension replicas actually add. Honesty note: on one CPU
+    the DRAIN tokens/s stays ~flat (XLA's intra-op pool already uses every
+    core, so N in-process engines add no FLOPs — it is reported anyway,
+    un-gamed); on real multi-chip serving each replica owns its own chip and
+    both rates scale. Arms are INTERLEAVED A/B/A/B with the wall kept
+    per arm as the MEDIAN of the interleaved passes (back-to-back arms pick
+    up allocator warm-up drift; minima flip under shared-CPU noise). Admission-control counters ride along so a
+    shedding/failing fleet can't pass as a fast one."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    # telemetry=False: ambient PERCEIVER_IO_TPU_TELEMETRY must not switch
+    # recording on inside a TIMED arm (same discipline as the profile arms)
+    routers = {
+        1: ServingRouter(model, params, num_replicas=1, num_slots=num_slots,
+                         telemetry=False),
+        num_replicas: ServingRouter(model, params, num_replicas=num_replicas,
+                                    num_slots=num_slots, telemetry=False),
+    }
+
+    def one_pass(router):
+        t0 = time.perf_counter()
+        handles = [
+            router.submit(r["prompt"], max_new_tokens=r["max_new_tokens"],
+                          rng=jax.random.PRNGKey(i))
+            for i, r in enumerate(requests)
+        ]
+        router.run_until_drained(max_steps=10_000)
+        drain_wall = time.perf_counter() - t0
+        assert all(h.ok for h in handles)  # a degraded pass must not be timed
+        admit_wall = max(h.admitted_at for h in handles) - t0
+        return admit_wall, drain_wall
+
+    for router in routers.values():  # warmup: compiles every covering bucket
+        one_pass(router)
+    admit_walls = {n: [] for n in routers}
+    drain_walls = {n: [] for n in routers}
+    for _ in range(repeats):
+        for n, router in routers.items():  # interleaved A/B
+            a, d = one_pass(router)
+            admit_walls[n].append(a)
+            drain_walls[n].append(d)
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    new_tokens = sum(r["max_new_tokens"] for r in requests)
+    prompt_tokens = sum(len(r["prompt"]) for r in requests)
+    arms = {}
+    for n, router in routers.items():
+        # MEDIAN, not best-of: the arm ratio is the acceptance number, and on
+        # a shared CPU the median of interleaved passes is far more stable
+        # than the minimum (measured: best-of flips across runs, median
+        # holds within a few percent)
+        admit, drain = _median(admit_walls[n]), _median(drain_walls[n])
+        snap = router.snapshot()
+        arms[f"replicas_{n}"] = {
+            "replicas": n,
+            "slots_per_replica": num_slots,
+            "admission_wall_seconds": round(admit, 4),
+            "admission_wall_all_repeats": [round(w, 4) for w in admit_walls[n]],
+            "admission_prompt_tokens_per_s": round(prompt_tokens / admit, 2)
+            if admit > 0 else 0.0,
+            "drain_wall_seconds": round(drain, 4),
+            "tokens_per_s": round(new_tokens / drain, 2) if drain > 0 else 0.0,
+            # admission-control outcomes: all zero on this healthy workload,
+            # reported so a degraded run surfaces next to its throughput
+            "failovers": snap["failovers"],
+            "shed_infeasible": snap["shed_infeasible"],
+            "rejected": snap["rejected"],
+            "timed_out": snap["timed_out"],
+            "failed": snap["failed"],
+            "breaker_transitions": snap["breaker_transitions"],
+        }
+        router.close()
+    single = arms["replicas_1"]
+    multi = arms[f"replicas_{num_replicas}"]
+    return {
+        "requests": len(requests),
+        "new_tokens_per_pass": new_tokens,
+        "prompt_tokens_per_pass": prompt_tokens,
+        **arms,
+        "throughput_speedup": round(multi["tokens_per_s"] / single["tokens_per_s"], 3)
+        if single["tokens_per_s"] > 0 else 0.0,
+        "admission_speedup": round(
+            multi["admission_prompt_tokens_per_s"]
+            / single["admission_prompt_tokens_per_s"], 3,
+        ) if single["admission_prompt_tokens_per_s"] > 0 else 0.0,
+    }
+
+
 def run_baseline(model, params, requests, warmup: bool):
     """Single-request serving: generate() per request, back-to-back, on the
     canonical padded shape (prompt left-padded to the full window)."""
@@ -287,7 +395,7 @@ def _run_decode_arm(model, params, prompts, num_slots: int, buckets, decode_toke
 
 
 def run_profile(model, config, num_slots: int, num_requests: int, seed: int,
-                decode_tokens: int = 32, repeats: int = 5) -> dict:
+                decode_tokens: int = 32, repeats: int = 5, params=None) -> dict:
     """Bucketed-ladder engine vs full-window-prefill engine on the short and
     full-window workloads; the short-workload ``admission_speedup`` is the
     acceptance number (target >= 2x on CPU). Admission passes are INTERLEAVED
@@ -296,12 +404,15 @@ def run_profile(model, config, num_slots: int, num_requests: int, seed: int,
     to invert the comparison, and single passes on a shared CPU are noisy.
     Even so the throughput view favors the baseline — CPU intra-op
     parallelism compresses the wall ratio well below the O(window/bucket)
-    FLOP ratio (a synced per-admission latency probe shows the full gap)."""
-    rng = jax.random.PRNGKey(seed)
-    init_ids = jnp.zeros((1, config.max_seq_len), jnp.int32)
-    params = jax.jit(model.init, static_argnames="prefix_len")(
-        rng, init_ids, prefix_len=model.max_prefix_len
-    )
+    FLOP ratio (a synced per-admission latency probe shows the full gap).
+    ``params`` lets the caller share one initialized model across arms (the
+    --replicas flow would otherwise pay the init jit twice)."""
+    if params is None:
+        rng = jax.random.PRNGKey(seed)
+        init_ids = jnp.zeros((1, config.max_seq_len), jnp.int32)
+        params = jax.jit(model.init, static_argnames="prefix_len")(
+            rng, init_ids, prefix_len=model.max_prefix_len
+        )
     workloads = profile_workloads(config, num_requests, seed)
     out = {
         "model": {
@@ -385,18 +496,48 @@ def main(argv=None) -> dict:
     ap.add_argument("--trace", default=None,
                     help="enable engine telemetry on the main workload and write "
                          "a Chrome trace (Perfetto-viewable) to this path")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run the replica-scaling arm: a burst workload through "
+                         "a 1-replica vs N-replica ServingRouter (interleaved, "
+                         "median-of --replica-repeats); the block lands in the "
+                         "--profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--replica-repeats", type=int, default=7)
     args = ap.parse_args(argv)
+    if args.replicas == 1:
+        ap.error("--replicas needs N >= 2 (the arm compares N replicas against 1)")
 
     from perceiver_io_tpu.obs import write_run_manifest
 
+    def replica_arm(model, config, params):
+        # burst workload ~6x one replica's capacity with UNIFORM generation
+        # length: slots free in crisp waves, so the admission wall measures
+        # exactly what extra replicas change (mixed lengths are the main
+        # bench's job, not this arm's)
+        workload = synth_workload(config, 6 * args.slots, args.seed)
+        for r in workload:
+            r["max_new_tokens"] = 24
+        scaling = run_replica_scaling(model, params, workload, args.replicas,
+                                      args.slots, repeats=args.replica_repeats)
+        scaling["preset"] = args.preset  # the merged artifact may mix presets
+        return scaling
+
     if args.profile:
         model, config = build_model(args.preset)
+        # one init shared by the profile arms and the optional replica arm
+        profile_params = jax.jit(model.init, static_argnames="prefix_len")(
+            jax.random.PRNGKey(args.seed),
+            jnp.zeros((1, config.max_seq_len), jnp.int32),
+            prefix_len=model.max_prefix_len,
+        )
         result = {
             "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "backend": jax.default_backend(),
             "preset": args.preset,
-            **run_profile(model, config, args.slots, args.requests, args.seed),
+            **run_profile(model, config, args.slots, args.requests, args.seed,
+                          params=profile_params),
         }
+        if args.replicas >= 2:
+            result["replica_scaling"] = replica_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
@@ -437,6 +578,31 @@ def main(argv=None) -> dict:
             result["engine_vs_baseline"] = round(
                 engine_res["tokens_per_s"] / base_res["tokens_per_s"], 3
             )
+
+    if args.replicas >= 2:
+        scaling = replica_arm(model, config, params)
+        result["replica_scaling"] = scaling
+        # the replica-scaling arm is part of the per-PR BENCH_serving.json
+        # story even without --profile: merge it into the existing artifact
+        # (other sections preserved) so the tracked file carries both
+        existing = {}
+        if os.path.exists(args.profile_out):
+            try:
+                with open(args.profile_out) as f:
+                    existing = json.load(f)
+            except (OSError, ValueError):
+                existing = {}  # unreadable artifact: rebuild around the new arm
+        existing["replica_scaling"] = scaling
+        existing["replica_scaling_recorded_at"] = result["recorded_at"]
+        existing.setdefault("backend", result["backend"])
+        tmp = args.profile_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(existing, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.profile_out)
+        manifest = write_run_manifest(args.profile_out, config=vars(args))
+        print(f"merged replica_scaling into {args.profile_out} (+ {manifest})",
+              file=sys.stderr)
 
     tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
     with open(tmp, "w") as f:

@@ -613,7 +613,7 @@ def test_metrics_v3_reader_normalizes_older_snapshots(tmp_path):
     from perceiver_io_tpu.serving import EngineMetrics, load_metrics_jsonl
     from perceiver_io_tpu.serving.metrics import SCHEMA
 
-    assert SCHEMA == "serving-metrics/v3"
+    assert SCHEMA == "serving-metrics/v4"
     path = tmp_path / "v3.jsonl"
     m = EngineMetrics(num_slots=2, jsonl_path=str(path))
     m.record_submit(0, prompt_len=3)
@@ -642,6 +642,9 @@ def test_metrics_v3_reader_normalizes_older_snapshots(tmp_path):
     }) + "\n")
     snap2 = load_metrics_jsonl(str(v2))["snapshots"][0]
     assert snap2["rejected"] is None and snap2["timed_out"] is None and snap2["failed"] is None
+    # pre-v4 snapshots also get None (not 0) for the multi-replica counters
+    assert snap2["failovers"] is None and snap2["shed_infeasible"] is None
+    assert snap["failovers"] == 0 and snap["breaker_transitions"] == {}  # v4 engine: real zeros
 
 
 # ------------------------------------------------------------- chaos driver

@@ -1,0 +1,599 @@
+"""Multi-replica router tests: dispatch parity, deterministic failover
+(token-identity at bucket boundaries, float64), circuit-breaker state
+machine, SLO shedding, churn/compile bounds, serving-metrics/v4, and the
+SIGTERM/SIGINT graceful drain.
+
+The failover contract (docs/serving.md, router section): after a replica is
+lost mid-decode, the router re-prefills ``prompt + already-emitted tokens``
+on a healthy replica and the greedy continuation is token-identical to the
+uninterrupted run — the widened ``write_slot`` left-pad path at a different
+covering bucket is the risk, so prompt AND continuation lengths straddle
+every ladder boundary here, in float64 where equality is exact.
+"""
+
+import json
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.generation.generate import GenerationConfig
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.reliability import armed
+from perceiver_io_tpu.serving import (
+    RequestStatus,
+    RouterMetrics,
+    ServingEngine,
+    ServingRouter,
+    load_metrics_jsonl,
+)
+from perceiver_io_tpu.serving.router import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+)
+
+VOCAB = 262
+WINDOW = 12
+LATENTS = 6
+
+
+def _make_model(param_dtype=jnp.float32):
+    config = CausalSequenceModelConfig(
+        vocab_size=VOCAB, max_seq_len=WINDOW, max_latents=LATENTS, num_channels=16,
+        num_heads=2, num_self_attention_layers=2, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config, param_dtype=param_dtype)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (1, 8), 0, VOCAB)
+    params = jax.jit(model.init, static_argnames="prefix_len")(rng, prompt, prefix_len=2)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return _make_model()
+
+
+def _engine_reference(model, params, prompts, max_new):
+    """Uninterrupted single-engine run — the fault-free baseline every
+    failover scenario is pinned against."""
+    engine = ServingEngine(model, params, num_slots=max(len(prompts), 1))
+    handles = [engine.submit(p, max_new_tokens=m) for p, m in zip(prompts, max_new)]
+    engine.run_until_drained(max_steps=500)
+    return [h.result().tolist() for h in handles]
+
+
+# ------------------------------------------------------------------- parity
+def test_router_greedy_parity_mixed_lengths(x64):
+    """Dispatch across replicas is invisible to outputs: greedy router
+    results are f64 token-identical to uninterrupted engine runs."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9], [40, 41, 42, 43, 44, 45, 46], list(range(100, 112)), [250]]
+    max_new = [5, 3, 6, 4]
+    expected = _engine_reference(model, params, prompts, max_new)
+    router = ServingRouter(model, params, num_replicas=2, num_slots=2)
+    handles = [router.submit(p, max_new_tokens=m) for p, m in zip(prompts, max_new)]
+    router.run_until_drained(max_steps=300)
+    for handle, want, prompt in zip(handles, expected, prompts):
+        assert handle.ok and handle.result().tolist() == want, f"prompt {prompt} diverged"
+        assert handle.failovers == 0
+    # load-based dispatch actually spread the work
+    snap = router.snapshot()
+    assert snap["schema"] == "serving-metrics/v4"
+    assert all(s["requests_admitted"] > 0 for s in snap["replicas"].values())
+    assert snap["failovers"] == 0 and snap["breaker_transitions"] == {}
+    router.close()
+
+
+def test_failover_token_identity_at_bucket_boundaries(x64):
+    """Acceptance: crash a replica after k emitted tokens and the failed-over
+    continuation (re-prefill of prompt + k tokens, possibly at a DIFFERENT
+    covering bucket) is f64 token-identical to the uninterrupted run, for
+    prompt/continuation lengths straddling every ladder boundary."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    k, max_new = 2, 5
+    bucket = LATENTS  # the default halving ladder here is (6, 12)
+    # prompt lengths putting PROMPT and CONTINUATION (= n + k) at 1 / bucket /
+    # bucket+1 / window: the bucket-crossing re-prefill is the risk path
+    lengths = sorted({1, bucket - k, bucket, bucket + 1 - k, bucket + 1, WINDOW - k})
+    prompts = [list(range(3, 3 + n)) for n in lengths]
+    expected = {n: _engine_reference(model, params, [p], [max_new])[0]
+                for n, p in zip(lengths, prompts)}
+
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           breaker_cooldown_ticks=1)
+    for n, prompt in zip(lengths, prompts):
+        victim = router.submit(prompt, max_new_tokens=max_new)
+        assert router.replicas[victim.replica].breaker == BREAKER_CLOSED
+        for _ in range(k):
+            router.step()
+        assert len(victim.output_ids) == k
+        with armed("replica.crash", slot=victim.replica, times=1):
+            router.run_until_drained(max_steps=300)
+        assert victim.ok and victim.failovers == 1, f"len {n}: {victim.status}"
+        assert victim.result().tolist() == expected[n], f"len {n} diverged after failover"
+        # the fleet fully recovers before the next case (1-tick cooldown)
+        for _ in range(4):
+            router.step()
+        assert all(r.breaker == BREAKER_CLOSED for r in router.replicas)
+    snap = router.snapshot()
+    assert snap["failovers"] == len(lengths)
+    router.close()
+
+
+def test_failover_bounded_and_partial_output_preserved(x64):
+    """A request that keeps losing replicas terminates FAILED after
+    max_failovers re-dispatches, with every token emitted so far preserved on
+    the handle (the TIMED_OUT partial-output discipline)."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    expected = _engine_reference(model, params, [[7, 3, 9]], [8])[0]
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           max_failovers=1, breaker_cooldown_ticks=64)
+    victim = router.submit([7, 3, 9], max_new_tokens=8)
+    router.step()
+    router.step()  # two tokens on r0
+    first_replica = victim.replica
+    seen = len(victim.output_ids)
+    with armed("replica.crash", slot=first_replica, times=1):
+        router.step()  # crash -> failover #1 to the sibling
+    assert victim.failovers == 1 and not victim.done
+    for _ in range(2):
+        router.step()  # a couple of continuation tokens on the new replica
+        # the streaming view is MONOTONIC through the replay: the salvage
+        # buffer answers until the new engine's stream overtakes it
+        assert len(victim.output_ids) >= seen
+        seen = len(victim.output_ids)
+    emitted_before = list(victim.output_ids)
+    assert len(emitted_before) >= 3
+    with armed("replica.crash", slot=victim.replica, times=1):
+        router.step()  # second loss exceeds max_failovers=1
+    assert victim.status is RequestStatus.FAILED
+    assert victim.finish_reason == "max_failovers"
+    assert victim.failovers == 2
+    # partial output preserved, and it is a PREFIX of the fault-free stream
+    assert victim.result().tolist() == emitted_before == expected[: len(emitted_before)]
+    router.close()
+
+
+def test_failover_parks_on_backpressure_not_rejected(setup):
+    """A failover continuation is ACCEPTED work: when every surviving queue
+    is momentarily at its bound it parks and retries, it is never terminally
+    REJECTED/queue_full the way a fresh submit would be."""
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           max_queue_depth=0, breaker_cooldown_ticks=64)
+    a = router.submit([1, 2, 3], max_new_tokens=6)
+    b = router.submit([4, 5], max_new_tokens=8)
+    router.step()  # both running, one per replica
+    with armed("replica.crash", slot=a.replica, times=1):
+        router.step()  # crash -> failover; survivor's queue is at bound 0
+    assert not a.done and a.failovers == 1
+    assert a.status is RequestStatus.QUEUED  # parked at the router, not killed
+    router.run_until_drained(max_steps=300)
+    assert a.ok and len(a.output_ids) == 6  # completed once the slot freed
+    assert b.ok and len(b.output_ids) == 8
+    router.close()
+
+
+# ------------------------------------------------------------------ breaker
+def test_breaker_stall_opens_then_half_open_recovery(setup):
+    """Acceptance: a stalled replica trips the slow-tick detector, its
+    breaker OPENs (requests failed over), cooldown is counted in ticks, the
+    HALF_OPEN probe closes it again, and it then serves new work."""
+    model, params = setup
+    router = ServingRouter(
+        model, params, num_replicas=2, num_slots=1,
+        # threshold far above a healthy tiny-model tick, far below the
+        # injected stall — strikes come only from the fault
+        slow_tick_threshold_s=0.25, slow_ticks_to_open=2,
+        breaker_cooldown_ticks=2,
+    )
+    # warm both replicas first; compile ticks ARE slow, but the detector's
+    # compile-tick exemption (engine program count moved) must absorb them —
+    # no strikes may survive warmup
+    warm = [router.submit([1, 2], max_new_tokens=1) for _ in range(2)]
+    router.run_until_drained(max_steps=20)
+    assert all(h.ok for h in warm)
+    assert all(r.consecutive_slow == 0 for r in router.replicas), \
+        "compile ticks must not strike the stall detector"
+    victim = router.submit([1, 2, 3], max_new_tokens=12)
+    survivor = router.submit([4, 5, 6], max_new_tokens=12)
+    router.step()
+    r0 = router.replicas[victim.replica]
+    assert r0.consecutive_slow == 0  # healthy ticks are under the threshold
+    with armed("replica.stall", slot=r0.rid, times=2, value=0.4):
+        router.step()  # strike 1
+        assert r0.breaker == BREAKER_CLOSED
+        router.step()  # strike 2 -> OPEN, victim fails over to the survivor's replica
+    assert r0.breaker == BREAKER_OPEN
+    assert victim.failovers == 1 and not victim.done  # failed over, still decoding
+    router.step()  # cooldown tick 1
+    assert r0.breaker == BREAKER_OPEN
+    router.step()  # cooldown elapsed -> HALF_OPEN, probe runs this tick
+    assert r0.breaker in (BREAKER_HALF_OPEN, BREAKER_CLOSED)
+    router.step()  # probe succeeded (fault exhausted): CLOSED
+    assert r0.breaker == BREAKER_CLOSED
+    router.run_until_drained(max_steps=200)
+    assert victim.ok and survivor.ok
+    assert len(victim.output_ids) == 12 and len(survivor.output_ids) == 12
+    trans = router.snapshot()["breaker_transitions"]
+    assert trans["closed->open"] == 1
+    assert trans["open->half_open"] == 1 and trans["half_open->closed"] == 1
+    # a recovered replica receives new work again
+    after = router.submit([9, 9], max_new_tokens=2)
+    router.run_until_drained(max_steps=50)
+    assert after.ok
+    router.close()
+
+
+def test_breaker_crash_failover_survivor_bit_identical(x64):
+    """Survivors on healthy replicas are bit-identical through a sibling's
+    crash-and-failover — the router never perturbs an unaffected engine."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    expected = _engine_reference(model, params, [[7, 3, 9], [40, 41, 42]], [6, 6])
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           breaker_cooldown_ticks=8)
+    victim = router.submit([7, 3, 9], max_new_tokens=6)
+    survivor = router.submit([40, 41, 42], max_new_tokens=6)
+    router.step()
+    with armed("replica.crash", slot=victim.replica, times=1):
+        router.run_until_drained(max_steps=200)
+    assert victim.ok and victim.result().tolist() == expected[0]
+    assert survivor.ok and survivor.failovers == 0
+    assert survivor.result().tolist() == expected[1]
+    router.close()
+
+
+def test_nan_failures_open_breaker(setup):
+    """Repeated NaN containments on one replica open its breaker: the sick
+    engine stops receiving work and its healthy requests fail over."""
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=2, num_slots=2,
+                           nan_failures_to_open=1, breaker_cooldown_ticks=64)
+    a = router.submit([1, 2, 3], max_new_tokens=10)   # -> r0
+    b = router.submit([4, 5], max_new_tokens=10)      # -> r1
+    c = router.submit([6, 7, 8], max_new_tokens=10)   # -> r0 (slot 2)
+    router.step()
+    r0 = router.replicas[a.replica]
+    assert c.replica == a.replica != b.replica
+    # poison r0's first occupied slot next tick (times=1: r0 ticks first)
+    with armed("serving.nan", times=1):
+        router.step()
+    assert a.status is RequestStatus.FAILED and a.finish_reason == "nonfinite_logits"
+    assert r0.breaker == BREAKER_OPEN  # threshold 1 tripped at harvest
+    assert c.failovers == 1 and not c.done  # healthy slot-mate moved, not lost
+    router.run_until_drained(max_steps=200)
+    assert b.ok and c.ok and len(c.output_ids) == 10
+    snap = router.snapshot()
+    assert snap["replicas"][f"r{r0.rid}"]["breaker"] == BREAKER_OPEN
+    assert snap["breaker_transitions"]["closed->open"] == 1
+    router.close()
+
+
+# ----------------------------------------------------------------- shedding
+def test_shed_infeasible_deadline_rejected_at_admission(setup):
+    """A deadlined request whose completion estimate (windowed p95 queue wait
+    + prefill + max_new x p95 decode) exceeds its deadline is REJECTED as
+    shed_infeasible at submit; requests without deadlines never shed."""
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           shed_min_samples=1)
+    # prime every replica's latency window with measured-slow history
+    for r in router.replicas:
+        m = r.engine.metrics
+        for i in range(4):
+            m.record_submit(1000 + i, prompt_len=2)
+            m.record_admit(1000 + i, slot=0, wait_s=0.5, prefill_s=0.05)
+            m.record_decode_step(active_slots=1, seconds=0.2, tokens=1)
+    # estimate ~= 0.5 + 0.05 + 10 * 0.2 = 2.55s >> 0.5s deadline -> shed
+    shed = router.submit([1, 2], max_new_tokens=10, deadline_s=0.5)
+    assert shed.status is RequestStatus.REJECTED
+    assert shed.finish_reason == "shed_infeasible"
+    # feasible deadline and no-deadline requests still admit
+    ok_deadline = router.submit([1, 2], max_new_tokens=1, deadline_s=60.0)
+    ok_plain = router.submit([3, 4], max_new_tokens=2)
+    router.run_until_drained(max_steps=100)
+    assert ok_deadline.ok and ok_plain.ok
+    snap = router.snapshot()
+    assert snap["shed_infeasible"] == 1 and snap["rejected"] == 1
+    # the JSONL-free path still reports the estimate through metrics counters
+    assert router.metrics.shed_infeasible == 1
+    router.close()
+
+
+def test_shed_disabled_and_cold_fleet_never_sheds(setup):
+    model, params = setup
+    cold = ServingRouter(model, params, num_replicas=1, num_slots=1)
+    h = cold.submit([1, 2], max_new_tokens=2, deadline_s=30.0)  # cold: no estimates yet
+    cold.run_until_drained(max_steps=50)
+    assert h.ok
+    cold.close()
+
+    off = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                        shed_infeasible=False, shed_min_samples=1)
+    m = off.replicas[0].engine.metrics
+    m.record_submit(999, prompt_len=2)
+    m.record_admit(999, slot=0, wait_s=5.0, prefill_s=0.5)
+    m.record_decode_step(active_slots=1, seconds=5.0, tokens=1)
+    h2 = off.submit([1, 2], max_new_tokens=2, deadline_s=0.0001)
+    # not shed (knob off) — it will time out on its own deadline instead
+    assert h2.finish_reason != "shed_infeasible"
+    off.run_until_drained(max_steps=50)
+    off.close()
+
+
+# ------------------------------------------------------------ drain / churn
+def test_router_drain_rejects_backlog_finishes_active(setup):
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1)
+    active = [router.submit([1, 2], max_new_tokens=4) for _ in range(2)]
+    assert all(h.status is RequestStatus.QUEUED for h in active)
+    router.step()  # both admitted (one per replica)
+    # the handle mirrors the engine surface: RUNNING once a slot is held
+    assert all(h.status is RequestStatus.RUNNING for h in active)
+    backlog = router.submit([3, 4], max_new_tokens=2)
+    drained = router.drain(max_steps=100)
+    assert all(h.ok and len(h.output_ids) == 4 for h in active)
+    assert backlog.status is RequestStatus.REJECTED
+    assert backlog.finish_reason == "draining"
+    post = router.submit([5, 6], max_new_tokens=2)
+    assert post.finish_reason == "draining"  # admission stays closed
+    assert {h.request_id for h in drained} == {h.request_id for h in active} | {backlog.request_id}
+    router.close()
+
+
+def test_router_churn_compile_bounds_no_per_failover_recompiles(setup):
+    """Acceptance: adding replicas adds at most one ladder of prefill/install
+    programs per replica and one decode program per replica, and a
+    crash-failover cycle compiles NOTHING new — failover re-prefill rides
+    the existing bucket ladder."""
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=2, num_slots=2,
+                           breaker_cooldown_ticks=1)
+    # churn across every bucket of the ladder on both replicas
+    lengths = [2, 5, 9, 3, 7, 12, 4, 11]
+    handles = []
+    for i, n in enumerate(lengths):
+        handles.append(router.submit(list(range(1, n + 1)), max_new_tokens=3,
+                                     rng=jax.random.PRNGKey(i)))
+        router.step()
+    router.run_until_drained(max_steps=300)
+    assert all(h.ok for h in handles)
+
+    def compile_counts():
+        return [
+            (r.engine.decode_compilations, r.engine.prefill_compilations,
+             r.engine._jit_install._cache_size())
+            for r in router.replicas
+        ]
+
+    before = compile_counts()
+    for decode, prefill, install in before:
+        assert decode == 1
+        assert prefill <= len(router.replicas[0].engine.prefill_buckets)
+        assert install <= len(router.replicas[0].engine.prefill_buckets)
+
+    # crash/failover churn: same programs, zero new compilations
+    victim = router.submit(list(range(1, 8)), max_new_tokens=5)
+    router.step()
+    with armed("replica.crash", slot=victim.replica, times=1):
+        router.run_until_drained(max_steps=300)
+    assert victim.ok and victim.failovers == 1
+    for _ in range(4):
+        router.step()  # recovery probe
+    assert compile_counts() == before, "failover must not compile new programs"
+    router.close()
+
+
+def test_engine_evict_request_api(setup):
+    """The engine-level eviction API the router's recovery path uses: queued
+    and running requests cancel cleanly with partial output preserved."""
+    model, params = setup
+    engine = ServingEngine(model, params, num_slots=1)
+    running = engine.submit([1, 2, 3], max_new_tokens=10)
+    queued = engine.submit([4, 5], max_new_tokens=4)
+    engine.step()
+    assert len(running.output_ids) == 1
+    got_q = engine.evict_request(queued.request_id, "cancelled",
+                                 status=RequestStatus.REJECTED)
+    assert got_q is queued and queued.status is RequestStatus.REJECTED
+    assert queued.finish_reason == "cancelled"
+    got_r = engine.evict_request(running.request_id, "cancelled",
+                                 status=RequestStatus.FAILED)
+    assert got_r is running and running.status is RequestStatus.FAILED
+    assert running.output_ids == got_r.output_ids and len(running.output_ids) == 1
+    assert engine.evict_request(running.request_id) is None  # already terminal
+    assert engine.evict_request(10_000) is None  # unknown id
+    assert engine.scheduler.active_slots == 0 and engine.scheduler.queue_depth == 0
+    snap = engine.metrics.snapshot()
+    assert snap["rejected"] == 1 and snap["failed"] == 1
+
+
+# ------------------------------------------------------------------ metrics
+def test_router_metrics_v4_jsonl_and_reader(tmp_path):
+    """RouterMetrics emits v4 snapshots with per-replica sections; the reader
+    round-trips them and still rejects unknown schemas."""
+    from perceiver_io_tpu.serving import EngineMetrics
+
+    path = tmp_path / "router.jsonl"
+    rm = RouterMetrics(num_replicas=2, jsonl_path=str(path))
+    rm.record_submit(0, prompt_len=3)
+    rm.record_dispatch(0, replica=1, load=-1)
+    rm.record_failover(0, from_replica=1, emitted_tokens=2, failover_n=1)
+    rm.record_breaker(1, "closed", "open", tick=5)
+    rm.record_shed(1, deadline_s=0.5, estimate_s=2.5)
+    rm.record_finish(0, "finished", "length", new_tokens=6, failovers=1)
+    em = EngineMetrics(num_slots=2)
+    em.record_decode_step(active_slots=1, seconds=0.1, tokens=1)
+    rm.write_snapshot({"r0": em.snapshot(), "r1": EngineMetrics(num_slots=2).snapshot()})
+    rm.close()
+
+    got = load_metrics_jsonl(str(path))
+    events = {e["event"] for e in got["events"]}
+    assert {"submit", "dispatch", "failover", "breaker", "shed", "finish", "snapshot"} <= events
+    snap = got["snapshots"][0]
+    assert snap["schema"] == "serving-metrics/v4"
+    assert snap["failovers"] == 1 and snap["shed_infeasible"] == 1
+    assert snap["breaker_transitions"] == {"closed->open": 1}
+    assert snap["tokens_generated"] == 1  # aggregated over replica sections
+    assert set(snap["replicas"]) == {"r0", "r1"}
+    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v4"
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v9"}) + "\n")
+    with pytest.raises(ValueError, match="unknown metrics schema"):
+        load_metrics_jsonl(str(bad))
+
+
+def test_router_submit_validation(setup):
+    model, params = setup
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1)
+    with pytest.raises(ValueError, match="non-empty"):
+        router.submit([])
+    with pytest.raises(ValueError, match="beam"):
+        router.submit([1, 2], config=GenerationConfig(max_new_tokens=2, num_beams=3))
+    with pytest.raises(ValueError, match="config or keyword"):
+        router.submit([1, 2], config=GenerationConfig(), max_new_tokens=2)
+    too_long = router.submit(list(range(WINDOW + 1)), max_new_tokens=2)
+    assert too_long.status is RequestStatus.REJECTED
+    assert too_long.finish_reason == "prompt_too_long"
+    with pytest.raises(ValueError, match="num_replicas"):
+        ServingRouter(model, params, num_replicas=0)
+    router.close()
+
+
+# -------------------------------------------------------- telemetry / bench
+def test_router_shared_trace_per_replica_report(setup, tmp_path):
+    """One shared recorder, per-replica span namespaces: the router summary
+    carries serving.rN phases + merged compile report, and obs_report splits
+    the trace into per-replica phase tables and per-category lifetimes."""
+    import importlib.util
+    import os
+
+    model, params = setup
+    trace_path = tmp_path / "router_trace.json"
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           telemetry=str(trace_path))
+    handles = [router.submit([i + 1, i + 2], max_new_tokens=3) for i in range(3)]
+    router.run_until_drained(max_steps=100)
+    summary = router.telemetry_summary()
+    assert "serving.r0.tick" in summary["phases"]
+    assert "serving.r1.tick" in summary["phases"]
+    assert "router.tick" in summary["phases"]
+    assert summary["compile"]["per_function"]["serving.r0.decode_step"]["compilations"] == 1
+    assert summary["compile"]["per_function"]["serving.r1.decode_step"]["compilations"] == 1
+    assert summary["compile"]["unexpected"] == []
+    router.close()  # writes the Chrome trace
+    assert all(h.ok for h in handles)
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_under_router_test",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rep = mod.report_trace(str(trace_path))
+    assert rep["validation_problems"] == []
+    # per-replica request namespaces (request.eN) + the router's own category
+    assert len(rep["request_lifetimes_by_cat"]) >= 3
+    groups = mod.split_replica_phases(rep["phases"])
+    assert {"serving.r0", "serving.r1"} <= set(groups)
+    tables = mod.replica_phase_tables(rep["phases"], "t")
+    assert any("[serving.r0]" in line for line in tables)
+    assert any("[serving.r1]" in line for line in tables)
+
+
+@pytest.mark.slow  # ~3 routers' worth of compiles
+def test_serve_bench_replica_scaling_smoke(tmp_path):
+    """--replicas merges the scaling arm (1 vs N replica routers, shed and
+    failover counters included) into the BENCH_serving.json artifact with a
+    manifest sibling."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_replicas_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "serve_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = tmp_path / "SERVE_BENCH.json"
+    pout = tmp_path / "BENCH_serving.json"
+    result = mod.main([
+        "--preset", "tiny", "--slots", "1", "--requests", "4",
+        "--replicas", "2", "--replica-repeats", "1",
+        "--no-baseline", "--no-warmup",
+        "--out", str(out), "--profile-out", str(pout),
+    ])
+    scaling = result["replica_scaling"]
+    assert scaling["replicas_1"]["tokens_per_s"] > 0
+    assert scaling["replicas_2"]["tokens_per_s"] > 0
+    assert scaling["admission_speedup"] > 0 and scaling["throughput_speedup"] > 0
+    # no shed/failover on the healthy workload, counters reported
+    for arm in ("replicas_1", "replicas_2"):
+        assert scaling[arm]["failovers"] == 0 and scaling[arm]["shed_infeasible"] == 0
+    on_disk = json.loads(pout.read_text())
+    assert on_disk["replica_scaling"]["replicas_2"]["slots_per_replica"] == 1
+    manifest = json.loads((tmp_path / "BENCH_serving.manifest.json").read_text())
+    assert manifest["schema"] == "run-manifest/v1"
+
+
+# ------------------------------------------------------------------ signals
+def test_sigterm_graceful_drain_flushes_metrics(setup, tmp_path):
+    """Satellite: SIGTERM mid-serve closes admission, rejects the backlog,
+    finishes active slots, and flushes the terminal metrics snapshot — then
+    the previous handlers are back (once-only)."""
+    model, params = setup
+    prev_term = signal.getsignal(signal.SIGTERM)
+    log = tmp_path / "router.jsonl"
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           handle_preemption=True, metrics_jsonl=str(log),
+                           replica_metrics_jsonl=str(tmp_path / "eng.r{i}.jsonl"))
+    active = [router.submit([1, 2, 3], max_new_tokens=6) for _ in range(2)]
+    router.step()  # both admitted
+    backlog = router.submit([4, 5], max_new_tokens=2)
+    signal.raise_signal(signal.SIGTERM)  # delivered to the main thread
+    assert signal.getsignal(signal.SIGTERM) == prev_term  # once-only: restored as it fired
+    drained = router.run_until_drained(max_steps=100)
+    assert router.preempted
+    assert all(h.ok and len(h.output_ids) == 6 for h in active)  # in-flight finished
+    assert backlog.finish_reason == "draining" and not backlog.ok
+    assert len(drained) == 3
+    post = router.submit([6], max_new_tokens=1)
+    assert post.finish_reason == "draining"
+    # the terminal snapshot landed in the JSONL before exit
+    got = load_metrics_jsonl(str(log))
+    assert got["snapshots"], "preemption must flush the final snapshot"
+    assert got["snapshots"][-1]["requests_finished"] == 2
+    # per-replica engine streams were written via the {i} template
+    for i in range(2):
+        eng_log = load_metrics_jsonl(str(tmp_path / f"eng.r{i}.jsonl"))
+        assert any(e["event"] == "admit" for e in eng_log["events"])
+    router.close()  # idempotent after the preemption flush
+
+
+def test_engine_sigterm_graceful_drain(setup, tmp_path):
+    """The engine-level handler mirrors the router's: drain + flush."""
+    model, params = setup
+    prev_term = signal.getsignal(signal.SIGTERM)
+    log = tmp_path / "engine.jsonl"
+    engine = ServingEngine(model, params, num_slots=1, handle_preemption=True,
+                           metrics_jsonl=str(log))
+    active = engine.submit([1, 2], max_new_tokens=5)
+    engine.step()
+    backlog = engine.submit([3, 4], max_new_tokens=2)
+    signal.raise_signal(signal.SIGINT)
+    while engine.step():
+        pass
+    assert engine.preempted
+    assert active.ok and len(active.output_ids) == 5
+    assert backlog.finish_reason == "draining"
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+    got = load_metrics_jsonl(str(log))
+    assert got["snapshots"] and got["snapshots"][-1]["requests_finished"] == 1
+    engine.close()
