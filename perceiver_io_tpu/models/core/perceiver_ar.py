@@ -44,6 +44,7 @@ from perceiver_io_tpu.models.core.adapter import (
 from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
 from perceiver_io_tpu.models.core.modules import LN_EPS, CrossAttentionLayer, SelfAttentionBlock
 from perceiver_io_tpu.ops.attention import KVCache
+from perceiver_io_tpu.ops.paged_decode_kernel import PagedKVCache
 from perceiver_io_tpu.ops.position import frequency_position_encoding, positions
 
 
@@ -126,6 +127,99 @@ class PerceiverARCache(flax.struct.PyTreeNode):
         )
 
 
+class PagedPerceiverARCache(flax.struct.PyTreeNode):
+    """Paged decode state for a Perceiver AR serving pool (docs/serving.md).
+
+    The dense pool (``PerceiverARCache`` at full window capacity per slot)
+    reserves ``window`` cross-attention KV rows per slot whether or not they
+    hold live tokens. Here the cross-attention KV lives in a shared PAGE POOL
+    (``ca``: ops/paged_decode_kernel.PagedKVCache) addressed through per-slot
+    page tables, so HBM cost scales with live tokens and admission/eviction
+    are page-table edits — the paged forms of ``write_slot`` (install_slot),
+    ``rewind``, and the ``live`` bookkeeping. The small self-attention cache
+    (capacity ``max_latents``) stays dense.
+
+    Engine-only invariants (serving/engine.py): every row sits at FULL window
+    occupancy at all times (the same invariant the dense pool pins via shared
+    cache lengths), so validity is fully encoded by ``live`` and the ring
+    offset ``ca.start`` — there is no pad-slot buffer and no shared length.
+    """
+
+    ca: PagedKVCache
+    sa: KVCache
+    shift: jax.Array  # (B, 1) left-pad position shift, as in PerceiverARCache
+    live: jax.Array  # (B,) live (non-pad) entries per row
+
+    def rewind(self, k: jax.Array) -> "PagedPerceiverARCache":
+        """Paged form of ``PerceiverARCache.rewind``: un-append the ``k`` most
+        recently written tokens by stepping the ring offset back (their pages
+        stay allocated — pages are only returned at eviction — so the slots
+        still hold the rewound values and the next append overwrites them
+        exactly, the speculative-verification contract)."""
+        k = jnp.asarray(k, jnp.int32)
+        return self.replace(
+            ca=self.ca.replace(start=jnp.mod(self.ca.start - k, self.ca.window)),
+            sa=self.sa.replace(length=jnp.maximum(self.sa.length - k, 0)),
+            live=jnp.maximum(self.live - k, 0),
+        )
+
+    def install_slot(
+        self, slot: jax.Array, table_row: jax.Array, src: PerceiverARCache
+    ) -> "PagedPerceiverARCache":
+        """Paged form of ``write_slot``: install a bucket-prefilled request
+        (``src``: batch-1 DENSE cache at bucket capacity, straight from the
+        shared prefill program) into pool slot ``slot`` whose page table row
+        becomes ``table_row`` (P,) — the first ceil(bucket/page) entries are
+        the freshly allocated pages that receive the bucket's KV rows
+        page-by-page, the remainder are the request's decode-growth
+        reservation (content written later by ``append_token``) padded with
+        the trash page. The ring offset starts at ``bucket mod window`` so
+        bucket row j lands at physical ring position j: positionally the
+        dense ``write_slot`` tail-scatter in a rotated frame (logical
+        position of ring slot j is ``(j - bucket) mod window`` = window -
+        bucket + j for the bucket rows), with the head left-pad represented
+        by ``live``/``shift`` alone instead of a zero-filled buffer."""
+        ps = self.ca.page_size
+        window = self.ca.window
+        bucket = src.ca.capacity
+        nb = -(-bucket // ps)  # pages holding bucket content
+        pad_rows = nb * ps - bucket
+        kc = jnp.pad(src.ca.k[0], ((0, pad_rows), (0, 0))).astype(self.ca.kp.dtype)
+        vc = jnp.pad(src.ca.v[0], ((0, pad_rows), (0, 0))).astype(self.ca.vp.dtype)
+        ids = table_row[:nb]
+        ca = self.ca.replace(
+            kp=self.ca.kp.at[ids].set(kc.reshape(nb, ps, -1)),
+            vp=self.ca.vp.at[ids].set(vc.reshape(nb, ps, -1)),
+            page_table=self.ca.page_table.at[slot].set(table_row),
+            start=self.ca.start.at[slot].set(bucket % window),
+        )
+        return self.replace(
+            ca=ca,
+            sa=self.sa.write_batch_row(slot, src.sa, batch_axis=1),
+            shift=jax.lax.dynamic_update_slice_in_dim(
+                self.shift, src.shift + (window - bucket), slot, axis=0
+            ),
+            live=jax.lax.dynamic_update_slice_in_dim(self.live, src.live, slot, axis=0),
+        )
+
+    def release_slot(self, slot: jax.Array) -> "PagedPerceiverARCache":
+        """Reset slot ``slot`` to the free canonical form: page table entries
+        all trash (page 0), ring offset 0, live pinned at the full window
+        (free rows decode discarded garbage exactly like the dense pool's
+        free slots). CRITICAL for correctness, not just hygiene: a freed
+        slot keeps decoding every tick, and a stale table entry would route
+        its writes into a page since reallocated to a live request."""
+        p = self.ca.pages_per_slot
+        return self.replace(
+            ca=self.ca.replace(
+                page_table=self.ca.page_table.at[slot].set(jnp.zeros((p,), jnp.int32)),
+                start=self.ca.start.at[slot].set(0),
+            ),
+            shift=self.shift.at[slot].set(0),
+            live=self.live.at[slot].set(self.ca.window),
+        )
+
+
 def _make_ar_cache(
     batch_size: int, max_seq_len: int, max_latents: int, num_layers: int, num_channels: int, dtype=jnp.float32
 ) -> PerceiverARCache:
@@ -137,6 +231,42 @@ def _make_ar_cache(
         pad_slots=jnp.zeros((batch_size, max_seq_len), dtype=bool),
         shift=jnp.zeros((batch_size, 1), dtype=jnp.int32),
         live=jnp.zeros((batch_size,), dtype=jnp.int32),
+    )
+
+
+def _make_paged_ar_cache(
+    batch_size: int,
+    max_seq_len: int,
+    max_latents: int,
+    num_layers: int,
+    num_channels: int,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.float32,
+) -> PagedPerceiverARCache:
+    """Paged decode-pool state: a shared (num_pages, page_size, C) KV page
+    pool (page 0 reserved as the trash page) + per-slot page tables over
+    ceil(max_seq_len / page_size) logical pages, dense self-attention caches
+    unchanged. ``page_size`` need not divide the window — the last logical
+    page's tail is simply never visible."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if page_size > max_seq_len:
+        raise ValueError(f"page_size ({page_size}) exceeds the window ({max_seq_len})")
+    pages_per_slot = -(-max_seq_len // page_size)
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is the reserved trash page), got {num_pages}")
+    return PagedPerceiverARCache(
+        ca=PagedKVCache(
+            kp=jnp.zeros((num_pages, page_size, num_channels), dtype),
+            vp=jnp.zeros((num_pages, page_size, num_channels), dtype),
+            page_table=jnp.zeros((batch_size, pages_per_slot), jnp.int32),
+            start=jnp.zeros((batch_size,), jnp.int32),
+            window=max_seq_len,
+        ),
+        sa=KVCache.create_stacked(num_layers, batch_size, max_latents, num_channels, num_channels, dtype),
+        shift=jnp.zeros((batch_size, 1), jnp.int32),
+        live=jnp.full((batch_size,), max_seq_len, jnp.int32),
     )
 
 
@@ -429,6 +559,54 @@ class PerceiverAR(nn.Module):
         assert x.shape[1] == 1, "decode_step processes one token at a time; use decode_block for chunks"
         return self.decode_block(x, cache)
 
+    def decode_step_paged(
+        self, x: jax.Array, cache: PagedPerceiverARCache
+    ) -> Tuple[jax.Array, PagedPerceiverARCache]:
+        """``decode_block`` with n = 1 against the PAGED pool. Every row sits
+        at full window occupancy (the serving-pool invariant), so the append
+        is the ring write ``PagedKVCache.append_token`` — O(1) per token where
+        the dense full-cache append ROLLS the whole KV buffer — and the
+        sliding-window re-positioning is pure arithmetic: ring slot r holds
+        logical window position ``(r - start) mod window``, so the RoPE table
+        and the visibility bound are computed per PHYSICAL slot from the
+        post-append ring offset. Token-for-token this assigns exactly the
+        angles and masks of the dense path in a rotated frame (f64
+        token-parity pinned by tests/test_paging.py)."""
+        b, n = x.shape
+        assert n == 1, "paged decode processes one token at a time"
+        window = cache.ca.window
+        rot = self._rotated_dim()
+
+        q_pos = jnp.maximum(window - 1 - cache.shift, 0)  # (B, 1)
+        x_emb, frq_q = self.input_adapter(x, abs_pos=q_pos)
+
+        # post-append ring state: append_token (inside cross_attention's paged
+        # branch) advances start by one; the new token's logical position is
+        # window - 1 and one more entry is live (saturating)
+        start_after = jnp.mod(cache.ca.start + 1, window)
+        live = jnp.minimum(cache.live + 1, window)
+        n_phys = cache.ca.pages_per_slot * cache.ca.page_size
+        logical = jnp.mod(jnp.arange(n_phys)[None, :] - start_after[:, None], window)
+        slot_pos = jnp.maximum(logical - cache.shift, 0)
+        rope_k_ca = frequency_position_encoding(slot_pos, rot)
+
+        x_latent, ca_cache = self.cross_attention(
+            x_emb, x_kv_prefix=x_emb[:, :0], rope_q=frq_q, rope_k=rope_k_ca,
+            kv_cache=cache.ca, kv_live=live,
+        )
+
+        # dense self-attention over the latents, exactly as decode_block n=1
+        # with the window full (n_after == window)
+        sa_cap = cache.sa.k.shape[2]
+        sa_len_after = jnp.minimum(cache.sa.length[0] + 1, sa_cap)
+        sa_slot_pos = window - sa_len_after + jnp.arange(sa_cap)[None, :]
+        sa_slot_pos = jnp.maximum(sa_slot_pos - cache.shift, 0)
+        rope_k_sa = frequency_position_encoding(sa_slot_pos, rot)
+        x_latent, sa_cache = self.self_attention(
+            x_latent, rope_q=frq_q, rope_k=rope_k_sa, kv_cache=cache.sa
+        )
+        return x_latent, cache.replace(ca=ca_cache, sa=sa_cache, live=live)
+
 
 class CausalSequenceModel(nn.Module):
     """Perceiver AR + token input adapter + optional final LN + tied token head."""
@@ -558,4 +736,25 @@ class CausalSequenceModel(nn.Module):
         ``PerceiverAR.decode_block`` for the n > 1 no-roll contract. Returns
         logits (B, n, vocab) — one next-token distribution per block position."""
         hidden, cache = self.ar.decode_block(x, cache)
+        return self._head(hidden), cache
+
+    def init_paged_cache(
+        self, batch_size: int, num_pages: int, page_size: int, dtype=jnp.float32
+    ) -> PagedPerceiverARCache:
+        """Paged decode-pool state for the serving engine (serving/paging.py):
+        a shared KV page pool + per-slot page tables in place of the dense
+        per-slot full-window cross-attention cache. Built from config only,
+        so it works on an unbound module."""
+        cfg = self.config
+        return _make_paged_ar_cache(
+            batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers,
+            cfg.num_channels, num_pages, page_size, dtype,
+        )
+
+    def decode_step_paged(
+        self, x: jax.Array, cache: PagedPerceiverARCache
+    ) -> Tuple[jax.Array, PagedPerceiverARCache]:
+        """One decode token against the paged pool; see
+        ``PerceiverAR.decode_step_paged``."""
+        hidden, cache = self.ar.decode_step_paged(x, cache)
         return self._head(hidden), cache

@@ -199,6 +199,63 @@ class MultiHeadAttention(nn.Module):
         kv = x @ kernel if bias is None else x @ kernel + bias
         return self.q_proj(x_q), kv[..., :num_qk], kv[..., num_qk:]
 
+    def _paged_cached_attention(self, q, k, v, kv_cache, rope_q, rope_k, kv_live, scale):
+        """Single-token causal decode against a paged KV pool (the serving
+        engine's hot path under paging — docs/serving.md). ``q``/``k``/``v``
+        are the UNSPLIT (B, 1, C) projections of the new token. The append is
+        an O(1) per-row scatter through the page table (vs the dense ring's
+        full-buffer roll); attention runs the fused paged kernel where
+        supported, else an XLA gather + masked softmax applying the identical
+        ``(start, live)`` visibility bound (``paged_visibility``) — the parity
+        contract tests/test_paging.py pins."""
+        from perceiver_io_tpu.ops import paged_decode_kernel as pdk
+        from perceiver_io_tpu.ops.decode_kernel import ragged_decode_enabled
+
+        b, n_q = q.shape[0], q.shape[1]
+        if n_q != 1 or not self.causal_attention:
+            raise ValueError("paged KV caches support single-token causal decode only")
+        if kv_live is None:
+            raise ValueError("paged attention requires kv_live (visibility is "
+                             "encoded by the ring offset + live count alone)")
+        if self.dropout > 0.0 and not self.deterministic:
+            raise ValueError("paged decode is inference-only (no attention dropout)")
+        num_qk, num_v, _ = self._dims()
+        kv_cache = kv_cache.append_token(k, v)
+        live = jnp.broadcast_to(jnp.asarray(kv_live, jnp.int32).reshape(-1), (b,))
+
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], self.num_heads, -1).transpose(0, 2, 1, 3)
+        q = split(q) * scale
+        if rope_q is not None:
+            q = apply_rope(q, rope_q)
+
+        n_phys = kv_cache.pages_per_slot * kv_cache.page_size
+        if self.use_flash is not False and pdk.paged_decode_supported(
+            kv_cache.page_size, num_qk, num_v, self.num_heads
+        ):
+            ang = rope_k if rope_k is not None else jnp.zeros((b, n_phys, 2), jnp.float32)
+            if ang.shape[0] != b:
+                ang = jnp.broadcast_to(ang, (b, *ang.shape[1:]))
+            o = pdk.fused_paged_decode_attention(
+                q, kv_cache.kp, kv_cache.vp, kv_cache.page_table, kv_cache.start,
+                live, ang, kv_cache.window,
+                # the ragged kill-switch disables the dead-page skip (every
+                # page fetched + masked) but never the visibility bound
+                skip_dead_pages=ragged_decode_enabled(),
+            )
+        else:
+            k_full, v_full = kv_cache.gather_dense()
+            kf, vf = split(k_full), split(v_full)
+            if rope_k is not None:
+                kf = apply_rope(kf, rope_k)
+            attn = jnp.einsum("bhic,bhjc->bhij", q, kf, preferred_element_type=jnp.float32)
+            neg = jnp.finfo(attn.dtype).min
+            visible = pdk.paged_visibility(kv_cache.start, live, kv_cache.window, n_phys)
+            attn = jnp.where(visible[:, None, None, :], attn, neg)
+            attn = jax.nn.softmax(attn, axis=-1).astype(vf.dtype)
+            o = jnp.einsum("bhij,bhjc->bhic", attn, vf)
+        o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
+        return self.o_proj(o), kv_cache
+
     def __call__(
         self,
         x_q: jax.Array,
@@ -225,7 +282,12 @@ class MultiHeadAttention(nn.Module):
         num_qk_per_head = num_qk // self.num_heads
         scale = num_qk_per_head**-0.5
 
-        if kv_live is not None:
+        paged = False
+        if kv_cache is not None:
+            from perceiver_io_tpu.ops.paged_decode_kernel import PagedKVCache
+
+            paged = isinstance(kv_cache, PagedKVCache)
+        if kv_live is not None and not paged:
             from perceiver_io_tpu.ops.decode_kernel import ragged_decode_enabled
 
             if kv_cache is None or not ragged_decode_enabled():
@@ -237,6 +299,14 @@ class MultiHeadAttention(nn.Module):
             q = self.q_proj(x_q)
             k = self.k_proj(x_kv)
             v = self.v_proj(x_kv)
+
+        if paged:
+            # Paged ring-cache decode (serving/paging.py; ops/paged_decode_kernel.py):
+            # the cache is a page-table-indirected pool, visibility is fully
+            # encoded by (start, live) — the ragged kill-switch governs only the
+            # kernel's dead-page skipping, never the masking bound (correctness
+            # needs it: there is no pad-slot buffer in the paged layout).
+            return self._paged_cached_attention(q, k, v, kv_cache, rope_q, rope_k, kv_live, scale)
 
         if kv_cache is not None:
             kv_cache = kv_cache.append(k, v)

@@ -1,0 +1,336 @@
+"""Paged ragged decode attention: the fused decode kernel generalized to a
+page-table-indirected KV layout (the Ragged Paged Attention recipe, PAPERS.md).
+
+The dense decode kernel (ops/decode_kernel.py) streams a per-slot (B, cap, C)
+KV ring buffer. The serving engine's slot pool pins that layout at FULL window
+capacity per slot, so HBM cost scales with pool capacity rather than live
+tokens. The paged layout breaks the per-slot reservation:
+
+  * one physical **page pool** ``kp``/``vp`` of shape (num_pages, page_size, C)
+    shared by every slot (page 0 is the reserved trash/garbage page — free
+    slots read and write it; its contents are never harvested);
+  * a per-slot **page table** (B, P) of physical page ids mapping the slot's
+    logical window onto pool pages (P = ceil(window / page_size); a window the
+    page size does not divide leaves the tail of the last page unused and
+    permanently masked);
+  * a per-slot ring offset ``start``: physical ring position r holds LOGICAL
+    window position ``(r - start) mod window``. A full-window append is then
+    O(1) — write the new token at ring position ``start`` (the slot that held
+    the dropped oldest token) and advance ``start`` — where the dense layout
+    ROLLS the whole (B, cap, C) buffer every token.
+
+Masking collapses to one bound: with ``live`` live (non-pad) entries, logical
+positions ``[window - live, window)`` are visible — no pad-slot buffer at all.
+The kernel's grid walks PHYSICAL pages; the index maps gather each page
+through the scalar-prefetched page table, pages with no live position alias
+the newest token's page (consecutive equal indices elide the DMA, so HBM
+traffic scales with live tokens), and their compute is skipped. Skipping is
+exact for the same reason as the dense kernel: an all-masked page contributes
+prob = 0 and rescales the flash state by exp(0) = 1, so omitting it leaves
+m/l/acc bit-identical (tests/test_paging.py pins this).
+
+The XLA fallback (the masked-softmax path in ops/attention.py's paged branch)
+gathers the pages dense and applies the same visibility bound — bitwise the
+same masking contract, used on CPU and wherever ``paged_decode_supported``
+says no.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.decode_kernel import _head_expander, _rotate_half_blockdiag
+
+
+class PagedKVCache(flax.struct.PyTreeNode):
+    """Paged cross-attention KV state for ONE batched decode pool.
+
+    ``kp`` / ``vp``: (num_pages, page_size, C) physical page pool, shared by
+        all batch rows. Page 0 is reserved as the trash page: free slots'
+        table entries point at it, their per-tick writes land in it, and its
+        contents are garbage by design (finite — only projected embeddings
+        are ever written — but never read into a harvested output).
+    ``page_table``: (B, P) int32 physical page id per logical page.
+    ``start``: (B,) int32 ring offset — physical position r holds logical
+        window position ``(r - start) mod window``; the NEXT append writes at
+        physical position ``start``.
+    ``window``: static logical window length (<= P * page_size).
+
+    Unlike the dense ``KVCache`` there is no shared ``length``: the serving
+    pool pins every slot at full window occupancy (the engine invariant the
+    dense pool also maintains), so validity is fully encoded by the per-row
+    ``live`` count threaded alongside (PagedPerceiverARCache.live).
+    """
+
+    kp: jax.Array
+    vp: jax.Array
+    page_table: jax.Array
+    start: jax.Array
+    window: int = flax.struct.field(pytree_node=False)
+
+    @property
+    def page_size(self) -> int:
+        return self.kp.shape[1]
+
+    @property
+    def num_pages(self) -> int:
+        return self.kp.shape[0]
+
+    @property
+    def pages_per_slot(self) -> int:
+        return self.page_table.shape[1]
+
+    def append_token(self, k_new: jax.Array, v_new: jax.Array) -> "PagedKVCache":
+        """Write one token's (B, 1, C) keys/values at each row's ring position
+        ``start`` — through the page table — and advance ``start``. O(1) per
+        token: the dense layout's full-buffer roll becomes a B-row scatter.
+        Rows whose table maps the write page to the trash page (free slots)
+        harmlessly deposit garbage there; distinct live slots never share a
+        writable page (the page pool's allocation invariant)."""
+        b = k_new.shape[0]
+        ps = self.page_size
+        bidx = jnp.arange(b)
+        page_ids = self.page_table[bidx, self.start // ps]
+        offs = self.start % ps
+        return self.replace(
+            kp=self.kp.at[page_ids, offs].set(k_new[:, 0].astype(self.kp.dtype)),
+            vp=self.vp.at[page_ids, offs].set(v_new[:, 0].astype(self.vp.dtype)),
+            start=jnp.mod(self.start + 1, self.window),
+        )
+
+    def gather_dense(self):
+        """(B, P*page_size, C) dense view through the page table — the XLA
+        fallback's input. Materializes the full logical window per row; the
+        kernel path exists so the serving hot loop never does."""
+        b = self.page_table.shape[0]
+        k = self.kp[self.page_table].reshape(b, -1, self.kp.shape[-1])
+        v = self.vp[self.page_table].reshape(b, -1, self.vp.shape[-1])
+        return k, v
+
+
+def paged_visibility(start: jax.Array, live: jax.Array, window: int, n_phys: int) -> jax.Array:
+    """(B, n_phys) bool: physical position r is VISIBLE iff its logical window
+    position ``(r - start) mod window`` lies in the live tail
+    ``[window - live, window)`` and r addresses a real window slot (r <
+    window — the unused tail of a partial last page is never visible). The
+    single masking contract shared bit-for-bit by the kernel and the XLA
+    fallback."""
+    r = jnp.arange(n_phys)[None, :]
+    lp = jnp.mod(r - start[:, None], window)
+    return (lp >= (window - live)[:, None]) & (r < window)
+
+
+def paged_decode_supported(
+    page_size: int, num_qk: int, num_v: int, num_heads: int = 1, n_q: int = 1
+) -> bool:
+    """Single-query paged decode on TPU: symmetric qk/v widths, sublane-aligned
+    pages. Multi-chip pools are not yet mapped onto this kernel (the paged
+    pool is a single shared buffer; shard_map dispatch is future work) — the
+    XLA fallback serves those. Kill-switch:
+    PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL (shared with the dense kernel)."""
+    import os
+
+    if os.environ.get("PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL", "0").lower() not in ("0", "false", ""):
+        return False
+    if jax.default_backend() != "tpu" or jax.device_count() > 1:
+        return False
+    return (
+        n_q == 1  # the engine's decode mode; chunked verification stays dense
+        and num_qk == num_v
+        and num_heads <= 128  # per-head stats live in one (8, 128) scratch row
+        and page_size % 8 == 0  # sublane-aligned page blocks
+        and page_size >= 8
+    )
+
+
+def _page_has_live(i, start, live, window: int, page_size: int):
+    """Does physical page ``i`` contain ANY live position? The live region is
+    the wrapped ring interval [start - live, start) (mod window). A page
+    intersects it iff the interval's first position s0 falls inside the page,
+    or the page's first row is itself live. Exact, branch-free — usable in
+    index maps (traced scalars only)."""
+    p0 = i * page_size
+    p1 = jnp.minimum(p0 + page_size, window) - 1
+    s0 = jnp.mod(start - live, window)
+    return (live > 0) & (((s0 >= p0) & (s0 <= p1)) | (jnp.mod(p0 - s0, window) < live))
+
+
+def _paged_kernel(start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref,
+                  rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref, *, window, skip_dead_pages):
+    """Grid (B, P); step (bi, i) covers physical ring positions
+    [i*ps, (i+1)*ps) of row bi, DMA'd through the page table.
+
+    start_ref (B,)        post-append ring offset (scalar prefetch, SMEM)
+    live_ref  (B,)        live (non-pad) entries per row
+    table_ref (B, P)      physical page ids
+    qbd_ref   (h*d, h)    block-diagonal scaled+rotated single query
+    k_ref     (1, ps, h*d) unrotated keys of ONE pool page
+    v_ref     (1, ps, h*d)
+    ang_ref   (1, ps, r)  rotary angles per PHYSICAL position (precomputed
+                          from the ring logical positions; pairwise-repeated)
+    rot_ref   (h*d, h*d)  block-diag rotate-half matrix
+    exp_ref   (h, h*d)    head->channel expander
+    o_ref     (1, 1, h*d) output
+    scratch: m, l (8, 128) VMEM (per-head stats in row 0), acc (8, h*d)
+
+    Pages with no live position are skipped entirely; their grid steps alias
+    the newest token's page in the index maps so no fresh DMA is issued.
+    Skipping is bit-exact: a fully-masked page contributes prob = 0 and
+    rescales m/l/acc by exp(0) = 1 (tests/test_paging.py pins skip-on vs
+    skip-off bitwise). The per-position visibility mask applies the SAME
+    bound, so mid-page live boundaries are exact too.
+    """
+    import jax.experimental.pallas as pl
+
+    bi = pl.program_id(0)
+    i = pl.program_id(1)
+    nblocks = pl.num_programs(1)
+    ps = k_ref.shape[1]
+    hd = k_ref.shape[2]
+    h = exp_ref.shape[0]
+    r = ang_ref.shape[2]
+    d = hd // h
+    contract = (((1,), (0,)), ((), ()))
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[bi]
+    live = live_ref[bi]
+    compute = _page_has_live(i, start, live, window, ps) if skip_dead_pages else i >= 0
+
+    @pl.when(compute)
+    def _compute():
+        ang = ang_ref[0].astype(jnp.float32)  # (ps, r)
+        fill = [jnp.ones((ps, d - r), jnp.float32)] if d > r else []
+        cos = jnp.concatenate(([jnp.cos(ang)] + fill) * h, -1)  # (ps, h*d)
+        sin = jnp.concatenate(([jnp.sin(ang)] + fill) * h, -1)
+
+        k = k_ref[0].astype(jnp.float32)  # (ps, h*d)
+        rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
+        k = k * cos + rot_half * sin
+
+        sc = jax.lax.dot_general(k, qbd_ref[:], contract, preferred_element_type=jnp.float32)  # (ps, h)
+        slot = i * ps + jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+        lp = jnp.mod(slot - start, window)
+        visible = (lp >= window - live) & (slot < window)  # (ps, 1)
+        sc = jnp.where(visible, sc, -jnp.inf)
+
+        m_prev = m_ref[0:1, :h]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))  # (1, h)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)  # (1, h)
+        prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (ps, h)
+
+        prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)
+        pv = jnp.sum(prob_x * v_ref[0].astype(jnp.float32), axis=0, keepdims=True)  # (1, h*d)
+        scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)
+
+        m_ref[0:1, :h] = m_new
+        l_ref[0:1, :h] = l_ref[0:1, :h] * scale + jnp.sum(prob, axis=0, keepdims=True)
+        acc_ref[0:1, :] = acc_ref[0:1, :] * scale_x + pv
+
+    @pl.when(i == nblocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[0:1, :h], 1e-30)
+        l_x = jax.lax.dot_general(1.0 / l, exp_ref[:], contract, preferred_element_type=jnp.float32)
+        o_ref[0] = (acc_ref[0:1, :] * l_x).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "skip_dead_pages", "interpret"))
+def fused_paged_decode_attention(
+    q: jax.Array,
+    kp: jax.Array,
+    vp: jax.Array,
+    page_table: jax.Array,
+    start: jax.Array,
+    live: jax.Array,
+    rope_k: jax.Array,
+    window: int,
+    skip_dead_pages: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """q (B, H, 1, D) scaled+rotated single query; kp/vp (N, ps, H*D)
+    unrotated page pool; page_table (B, P); start (B,) POST-append ring
+    offset; live (B,) live-entry counts; rope_k (B, P*ps, R) angles laid out
+    per PHYSICAL ring position. Returns (B, H, 1, D).
+
+    ``skip_dead_pages=False`` disables the dead-page alias/skip (every page is
+    fetched and masked) — the bitwise-parity reference arm and the ragged
+    kill-switch behavior (ragged_decode_enabled, ops/decode_kernel.py)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n_q, d = q.shape
+    assert n_q == 1, "paged decode is single-query (the engine's decode mode)"
+    n_pages, ps, hd = kp.shape
+    p = page_table.shape[1]
+    r = rope_k.shape[-1]
+
+    start = jnp.asarray(start, jnp.int32).reshape(-1)
+    live = jnp.asarray(live, jnp.int32).reshape(-1)
+    # block-diagonal query: column ``head`` carries q[:, head, 0] in rows
+    # [head*d, (head+1)*d) — one (ps, h*d) x (h*d, h) matmul scores all heads
+    eye = jnp.eye(h, dtype=q.dtype)
+    qbd = (
+        q[:, :, 0, :][:, :, None, :] * eye[None, :, :, None]
+    )  # (b, head, col, d)
+    qbd = qbd.transpose(0, 1, 3, 2).reshape(b, h * d, h)
+
+    def _alias(i, start_ref, live_ref, bi):
+        # dead pages alias the newest token's page — a page some step fetches
+        # anyway, and consecutive equal indices elide the DMA
+        if not skip_dead_pages:
+            return i
+        s, lv = start_ref[bi], live_ref[bi]
+        newest = jnp.mod(s - 1, window) // ps
+        return jnp.where(_page_has_live(i, s, lv, window, ps), i, newest)
+
+    def _kv_map(bi, i, start_ref, live_ref, table_ref):
+        return (table_ref[bi, _alias(i, start_ref, live_ref, bi)], 0, 0)
+
+    def _ang_map(bi, i, start_ref, live_ref, table_ref):
+        return (bi, _alias(i, start_ref, live_ref, bi), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, p),
+        in_specs=[
+            pl.BlockSpec((None, h * d, h), lambda bi, i, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, ps, hd), _kv_map),
+            pl.BlockSpec((1, ps, hd), _kv_map),
+            pl.BlockSpec((1, ps, r), _ang_map),
+            pl.BlockSpec((h * d, h * d), lambda bi, i, *_: (0, 0)),
+            pl.BlockSpec((h, h * d), lambda bi, i, *_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, hd), lambda bi, i, *_: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, window=window, skip_dead_pages=skip_dead_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
+        interpret=interpret,
+    )(
+        start,
+        live,
+        jnp.asarray(page_table, jnp.int32),
+        qbd,
+        kp,
+        vp,
+        rope_k,
+        jnp.asarray(_rotate_half_blockdiag(h, d, r)),
+        jnp.asarray(_head_expander(h, d)),
+    )
+    return out.reshape(b, 1, h, d).transpose(0, 2, 1, 3)

@@ -22,11 +22,21 @@ from perceiver_io_tpu.serving.metrics import (
     RouterMetrics,
     load_metrics_jsonl,
 )
+from perceiver_io_tpu.serving.paging import (
+    PagePool,
+    paged_kv_enabled,
+    pages_for_request,
+    pages_for_tokens,
+)
 from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
 __all__ = [
     "EngineMetrics",
+    "PagePool",
+    "paged_kv_enabled",
+    "pages_for_request",
+    "pages_for_tokens",
     "RequestStatus",
     "RoutedRequest",
     "RouterMetrics",

@@ -57,16 +57,34 @@ Telemetry (docs/observability.md): ``ServingEngine(telemetry=...)`` (or the
 ``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans per tick (admit /
 prefill dispatch / install / decode dispatch / sample-sync / evict),
 per-request lifecycle spans keyed by request id (joinable against the
-serving-metrics/v4 JSONL events), and a compile watchdog that flags any
+serving-metrics/v5 JSONL events), and a compile watchdog that flags any
 program count growing past the churn-never-recompiles budgets at runtime.
 Off by default; the disabled path holds the shared no-op recorder and the
 greedy-parity and compile-count pins run through it unchanged.
 
+Paged KV cache (docs/serving.md "Paged KV cache"; serving/paging.py): with
+``kv_page_size`` set, the per-slot full-window cross-attention cache is
+replaced by a shared physical PAGE POOL addressed through per-slot page
+tables — HBM cost scales with live tokens, not pool capacity. Admission
+allocates the request's whole reservation (covering bucket + max_new_tokens,
+capped at the window) from a refcounted, deterministic free list and scatters
+the bucket KV into those pages; eviction returns the pages (no O(window) row
+zeroing); the compiled decode step appends O(1) per token at each slot's ring
+offset instead of rolling the whole buffer. Pool exhaustion head-blocks the
+FIFO queue, so it surfaces as the existing ``queue_full`` backpressure —
+never a crash or a stalled running slot (mid-decode page faults cannot exist
+by construction). Free slots' tables point at the reserved trash page; the
+churn contract is unchanged (one decode program, <= one install program per
+bucket, pinned).
+
 Kill-switches: ``PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL=1`` pins the
 ladder at the single full-window bucket (the PR-1 behavior);
 ``PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1`` disables live-length masking
-and block skipping (pad masking alone);
-``PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL=1`` disables the fused kernel.
+and block skipping (pad masking alone; under paging only the kernel's
+dead-page skip — the visibility bound is load-bearing there);
+``PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL=1`` disables the fused kernel;
+``PERCEIVER_IO_TPU_DISABLE_PAGED_KV=1`` forces the dense pool even when
+``kv_page_size`` is configured (f64 greedy parity pinned both ways).
 
 Greedy engine output is token-identical to ``generate()`` on the same
 canonical form (tests/test_serving.py pins this in float64); sampled output
@@ -98,6 +116,7 @@ from perceiver_io_tpu.reliability.preemption import (
     restore_preemption_handler,
 )
 from perceiver_io_tpu.serving.metrics import EngineMetrics
+from perceiver_io_tpu.serving.paging import PagePool, paged_kv_enabled, pages_for_request
 from perceiver_io_tpu.serving.scheduler import SlotScheduler
 
 
@@ -169,6 +188,14 @@ class ServedRequest:
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
     deadline_s: Optional[float] = None  # TTL from submit; enforced at ticks
+    # paged engines: the request's page reservation, computed ONCE at submit
+    # (it is a pure function of the prompt/config — engine.load and the
+    # admission gate read it per tick, so re-deriving it would make the
+    # queue-bound check O(queue * ladder)); None on dense pools
+    pages_reserved: Optional[int] = None
+    # the admission's actual allocation (== pages_reserved once RUNNING) —
+    # the router's failover test pins replay reservations against this
+    pages_allocated: Optional[int] = None
     # deterministic state replay (router failover, docs/serving.md): tokens
     # force-fed through the compiled decode step after prefill, reproducing
     # the source engine's exact decode trajectory — including the rng chain —
@@ -259,6 +286,8 @@ class ServingEngine:
         telemetry=None,
         obs_ns: str = "serving",
         handle_preemption: bool = False,
+        kv_page_size: Optional[int] = None,
+        num_kv_pages: Optional[int] = None,
     ):
         self.model = model
         self.params = params
@@ -280,7 +309,7 @@ class ServingEngine:
         self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
         # unified telemetry (docs/observability.md): phase spans per tick,
         # per-request lifecycle spans keyed by request id (joinable against
-        # the serving-metrics/v4 events carrying the same request_id), and a
+        # the serving-metrics/v5 events carrying the same request_id), and a
         # compile watchdog policing the churn-never-recompiles invariant at
         # runtime. Off by default: ``telemetry=None`` defers to the
         # PERCEIVER_IO_TPU_TELEMETRY env, and the disabled surface is the
@@ -359,17 +388,60 @@ class ServingEngine:
                 )
         self.prefill_buckets: tuple = (self._window,) if disable else ladder
 
-        # Device pool: batched cache pinned at FULL capacity (free slots hold
-        # zeros — harmless; see module docstring) + per-slot state. Free-slot
-        # live lengths are pinned at the full window so the ragged decode
-        # kernel treats them exactly like the pre-ragged path (outputs
-        # discarded either way).
-        cache = model.init_cache(batch_size=num_slots, dtype=self.cache_dtype)
-        self._cache = cache.replace(
-            ca=cache.ca.replace(length=jnp.asarray(cache.ca.capacity, jnp.int32)),
-            sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
-            live=jnp.full((num_slots,), cache.ca.capacity, jnp.int32),
-        )
+        # Paged KV mode (serving/paging.py; module docstring): kv_page_size
+        # opts in, the kill-switch env forces dense regardless — the f64
+        # parity pins run both ways.
+        self.paged = kv_page_size is not None and paged_kv_enabled()
+        self.kv_page_size: Optional[int] = None
+        self._pool: Optional[PagePool] = None
+        if kv_page_size is not None and not 1 <= int(kv_page_size) <= self._window:
+            raise ValueError(
+                f"kv_page_size must lie in [1..window={self._window}], got {kv_page_size}"
+            )
+        if self.paged:
+            self.kv_page_size = int(kv_page_size)
+            self._pages_per_slot = -(-self._window // self.kv_page_size)
+            # default pool = exactly the dense layout's backing (one full
+            # window per slot) + the reserved trash page: paged-but-same-
+            # capacity, so enabling paging alone never ADDS admission blocking
+            pages = (
+                int(num_kv_pages) if num_kv_pages is not None
+                else num_slots * self._pages_per_slot + 1
+            )
+            if pages < self._pages_per_slot + 1:
+                # the worst-case single reservation is a full window of pages;
+                # a smaller pool would head-block that request forever
+                raise ValueError(
+                    f"num_kv_pages must be >= pages_per_slot + 1 = "
+                    f"{self._pages_per_slot + 1} (worst-case reservation + trash "
+                    f"page), got {pages}"
+                )
+            self._pool = PagePool(pages, reserved=1)
+            self._slot_pages: List[Optional[List[int]]] = [None] * num_slots
+            # request id currently head-blocked on the free list, so a long
+            # block reports one alloc_failure episode rather than one per tick
+            self._alloc_blocked_id: Optional[int] = None
+            cache = model.init_paged_cache(
+                num_slots, pages, self.kv_page_size, dtype=self.cache_dtype
+            )
+            # factory pins live at the window; pin the SA lengths full too —
+            # the shared-fill-level invariant the dense pool also maintains
+            self._cache = cache.replace(
+                sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
+            )
+            self.metrics.set_page_pool(self._pool.num_pages - self._pool.reserved, 0)
+        else:
+            # Device pool: batched cache pinned at FULL capacity (free slots
+            # hold zeros — harmless; see module docstring) + per-slot state.
+            # Free-slot live lengths are pinned at the full window so the
+            # ragged decode kernel treats them exactly like the pre-ragged
+            # path (outputs discarded either way).
+            cache = model.init_cache(batch_size=num_slots, dtype=self.cache_dtype)
+            self._cache = cache.replace(
+                ca=cache.ca.replace(length=jnp.asarray(cache.ca.capacity, jnp.int32)),
+                sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
+                live=jnp.full((num_slots,), cache.ca.capacity, jnp.int32),
+            )
         # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
         # serving); storing them narrower would silently cast at install
         self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
@@ -392,6 +464,8 @@ class ServingEngine:
                                 budget=len(self.prefill_buckets))
             self.watchdog.watch(f"{obs_ns}.release", self._jit_release, budget=1)
             self.watchdog.watch(f"{obs_ns}.quarantine", self._jit_quarantine, budget=1)
+            if self._jit_release_pages is not None:
+                self.watchdog.watch(f"{obs_ns}.release_pages", self._jit_release_pages, budget=1)
 
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
@@ -413,16 +487,9 @@ class ServingEngine:
             )
             return logits[:, -1], cache
 
-        # cache/state buffers are donated everywhere the caller immediately
-        # rebinds them: without donation every decoded token would COPY the
-        # full slot-pool KV cache (num_slots x layers x window x channels)
-        # instead of updating it in place. (CPU jax warns donation is
-        # unsupported and falls back to copies — correct either way.)
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def install(cache, state, slot, req_cache, req_logits, rng,
-                    temperature, top_k, top_p, do_sample, pad_id):
-            cache = cache.write_slot(slot, req_cache)
-            state = state.replace(
+        def _install_state(state, slot, req_logits, rng,
+                           temperature, top_k, top_p, do_sample, pad_id):
+            return state.replace(
                 next_logits=state.next_logits.at[slot].set(req_logits[0]),
                 rng=state.rng.at[slot].set(rng),
                 active=state.active.at[slot].set(True),
@@ -432,6 +499,32 @@ class ServingEngine:
                 do_sample=state.do_sample.at[slot].set(do_sample),
                 pad_id=state.pad_id.at[slot].set(pad_id),
             )
+
+        # cache/state buffers are donated everywhere the caller immediately
+        # rebinds them: without donation every decoded token would COPY the
+        # full slot-pool KV cache (num_slots x layers x window x channels)
+        # instead of updating it in place. (CPU jax warns donation is
+        # unsupported and falls back to copies — correct either way.)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def install(cache, state, slot, req_cache, req_logits, rng,
+                    temperature, top_k, top_p, do_sample, pad_id):
+            cache = cache.write_slot(slot, req_cache)
+            state = _install_state(state, slot, req_logits, rng,
+                                   temperature, top_k, top_p, do_sample, pad_id)
+            return cache, state
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def install_paged(cache, state, slot, table_row, req_cache, req_logits, rng,
+                          temperature, top_k, top_p, do_sample, pad_id):
+            # paged admission: scatter the BUCKET-shaped prefill cache into
+            # the freshly allocated pages and write the slot's page-table row
+            # (reservation + trash padding). Like the dense install this
+            # consumes the bucket-shaped req_cache, so it owns one legitimate
+            # program per ladder bucket — table_row is a fixed (P,) array,
+            # so varying reservations never add programs.
+            cache = cache.install_slot(slot, table_row, req_cache)
+            state = _install_state(state, slot, req_logits, rng,
+                                   temperature, top_k, top_p, do_sample, pad_id)
             return cache, state
 
         @partial(jax.jit, donate_argnums=(0,))
@@ -451,6 +544,20 @@ class ServingEngine:
                 rng=state.rng.at[slot].set(0),
                 next_logits=state.next_logits.at[slot].set(0),
             )
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def release_pages(cache, slot):
+            # paged eviction's device half: table row -> trash page, ring
+            # offset 0, live pinned full (the free-slot canonical form). NOT
+            # hygiene — a freed slot keeps decoding, and a stale table entry
+            # would route its writes into a page since handed to a new
+            # tenant. The page CONTENTS are untouched: returning ids to the
+            # free list replaces the dense path's O(window) row zeroing.
+            return cache.release_slot(slot)
+
+        decode_method = (
+            type(model).decode_step_paged if self.paged else type(model).decode_step
+        )
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_step(params, cache, state, forced, use_forced):
@@ -477,7 +584,7 @@ class ServingEngine:
             # f64 parity pins run through it.
             tok = jnp.where(use_forced, forced, tok).astype(jnp.int32)
             logits_t, cache = model.apply(
-                params, tok[:, None], cache, method=type(model).decode_step
+                params, tok[:, None], cache, method=decode_method
             )
             # inactive rows keep their (zeroed-at-release) rng/logits frozen:
             # freed-slot state stays canonical across steps, so pool dumps are
@@ -507,11 +614,33 @@ class ServingEngine:
                 live=cache.live.at[slot].set(cache.ca.capacity),
             )
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def quarantine_paged(cache, slot, table_row):
+            # paged containment: zero the condemned slot's SA rows and every
+            # page its table references (trash-padding entries re-zero the
+            # trash page — duplicate scatter indices with identical zero
+            # payloads, deterministic) BEFORE the pages return to the free
+            # list. A normally-evicted page's stale FINITE garbage is safe
+            # for the next tenant (gathered at softmax weight 0), but a NaN
+            # would poison the sum through 0 * NaN — the same reason the
+            # dense quarantine zeroes its rows. O(pages), not O(window *
+            # slots), and only on the containment path.
+            ca = cache.ca
+            return cache.replace(
+                ca=ca.replace(
+                    kp=ca.kp.at[table_row].set(0), vp=ca.vp.at[table_row].set(0)
+                ),
+                sa=cache.sa.replace(
+                    k=cache.sa.k.at[:, slot].set(0), v=cache.sa.v.at[:, slot].set(0)
+                ),
+            )
+
         self._jit_prefill = prefill_one
-        self._jit_install = install
+        self._jit_install = install_paged if self.paged else install
         self._jit_release = release
+        self._jit_release_pages = release_pages if self.paged else None
         self._jit_decode = decode_step
-        self._jit_quarantine = quarantine
+        self._jit_quarantine = quarantine_paged if self.paged else quarantine
 
     @property
     def decode_compilations(self) -> int:
@@ -529,10 +658,71 @@ class ServingEngine:
         compile-tick detector: a tick whose count moved paid a compile, so
         its duration must not count as a stall strike (five int reads,
         cheap enough per tick)."""
-        return sum(f._cache_size() for f in (
+        jits = [
             self._jit_prefill, self._jit_install, self._jit_decode,
             self._jit_release, self._jit_quarantine,
-        ))
+        ]
+        if self._jit_release_pages is not None:
+            jits.append(self._jit_release_pages)
+        return sum(f._cache_size() for f in jits)
+
+    # -------------------------------------------------------------- capacity
+    @property
+    def load(self) -> int:
+        """Backlog beyond free capacity — the engine's queue-bound metric and
+        the router's dispatch-ranking input (one definition of "how full").
+        Dense pools: ``SlotScheduler.load`` (queue depth minus free slots).
+        Paged pools, capacity = free PAGES as much as free rows: the count of
+        queued requests (FIFO order — admission is head-of-line) the free
+        slots and free pages can absorb, plus worst-case-sized headroom
+        beyond the queue. Conservative under page pressure, identical to the
+        dense number when the pool is unconstrained (the default sizing)."""
+        if not self.paged:
+            return self.scheduler.load
+        slots = self.scheduler.free_slots
+        pages = self._pool.free_pages
+        absorbed = 0
+        for request in self.scheduler.queued():
+            if slots <= 0:
+                break
+            need = self._pages_for(request)
+            if need > pages:
+                break  # head-of-line: later requests wait behind this one
+            slots -= 1
+            pages -= need
+            absorbed += 1
+        headroom = min(slots, pages // self._pages_per_slot)
+        return self.scheduler.queue_depth - absorbed - headroom
+
+    def _pages_for(self, request: ServedRequest) -> int:
+        """The request's up-front page reservation (serving/paging.py):
+        covering bucket + full generation budget, capped at the window.
+        Computed once per request (at submit) and cached on the handle —
+        ``load`` walks the queue with it per tick."""
+        if request.pages_reserved is None:
+            bucket = self._bucket_for(request.prompt_ids.size)
+            request.pages_reserved = pages_for_request(
+                bucket, request.config.max_new_tokens, self._window, self.kv_page_size
+            )
+        return request.pages_reserved
+
+    def _can_admit_paged(self, request: ServedRequest) -> bool:
+        """Admission gate for ``SlotScheduler.pop_admissible``: does the free
+        list cover the head request's reservation? A blocked head counts one
+        ``alloc_failure`` per blocking EPISODE (not per tick — a long block
+        must not flood the metrics stream) and stays queued — pool exhaustion
+        is never a crash and never skips FIFO order."""
+        need = self._pages_for(request)
+        if self._pool.can_allocate(need):
+            if self._alloc_blocked_id == request.request_id:
+                self._alloc_blocked_id = None  # episode over
+            return True
+        if self._alloc_blocked_id != request.request_id:
+            self._alloc_blocked_id = request.request_id
+            self.metrics.record_alloc_failure(request.request_id, need, self._pool.free_pages)
+            if self._obs_on:
+                self._obs.counter_inc(f"{self._obs_ns}.alloc_failures")
+        return False
 
     # ------------------------------------------------------------------ submit
     def submit(
@@ -601,12 +791,15 @@ class ServingEngine:
             return self._reject(request, "draining")
         if prompt.size > self._window:
             return self._reject(request, "prompt_too_long")
-        # the bound limits the backlog BEYOND available slot capacity: every
+        # the bound limits the backlog BEYOND available capacity: every
         # submit transits the queue (admission happens at tick boundaries),
         # so a raw queue_depth check would reject a burst into an idle
         # engine while its slots sit free. max_queue_depth=0 therefore
-        # means "no waiting beyond what the free slots will absorb".
-        if self.max_queue_depth is not None and self.scheduler.load >= self.max_queue_depth:
+        # means "no waiting beyond what the free capacity will absorb" —
+        # under paging, capacity counts free PAGES as much as free slots
+        # (engine.load), which is how pool exhaustion surfaces as the same
+        # queue_full backpressure instead of a new failure mode.
+        if self.max_queue_depth is not None and self.load >= self.max_queue_depth:
             return self._reject(request, "queue_full")
         self._requests[request.request_id] = request
         self.scheduler.enqueue(request)
@@ -651,23 +844,43 @@ class ServingEngine:
         cfg = request.config
         t0 = time.perf_counter()
         bucket = self._bucket_for(request.prompt_ids.size)
+        pages: Optional[int] = None
+        if self.paged:
+            # the ONLY allocation point (serving/paging.py): the whole
+            # reservation — bucket + generation budget — is claimed here, so
+            # a running slot can never page-fault. pop_admissible's
+            # _can_admit_paged gate guaranteed the fit.
+            pages = self._pages_for(request)
+            page_ids = self._pool.allocate(pages)
+            self._slot_pages[slot] = page_ids
+            table_row = np.zeros((self._pages_per_slot,), np.int32)
+            table_row[: len(page_ids)] = page_ids  # trash-padded reservation
         with self._obs.span(self._span_prefill):
             ids, pad_mask = self._bucket_prompt(request, bucket)
             req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
         with self._obs.span(self._span_install):
-            self._cache, self._state = self._jit_install(
-                self._cache, self._state, slot, req_cache, req_logits, request.rng,
-                # greedy requests ignore temperature/top_k/top_p (argmax survives
-                # scaling and filtering): install the neutral encodings so any
-                # user value — including temperature <= 0 — shares the one
-                # compiled step, and a greedy slot never keeps the batch-wide
-                # vocab-sort filter branches live (see _jit_release)
+            # greedy requests ignore temperature/top_k/top_p (argmax survives
+            # scaling and filtering): install the neutral encodings so any
+            # user value — including temperature <= 0 — shares the one
+            # compiled step, and a greedy slot never keeps the batch-wide
+            # vocab-sort filter branches live (see _jit_release)
+            sampling = (
                 float(cfg.temperature) if cfg.do_sample else 1.0,
                 int(cfg.top_k) if (cfg.do_sample and cfg.top_k) else 0,
                 float(cfg.top_p) if (cfg.do_sample and cfg.top_p is not None) else 1.0,
                 bool(cfg.do_sample),
                 int(cfg.pad_token_id),
             )
+            if self.paged:
+                self._cache, self._state = self._jit_install(
+                    self._cache, self._state, slot, jnp.asarray(table_row),
+                    req_cache, req_logits, request.rng, *sampling,
+                )
+            else:
+                self._cache, self._state = self._jit_install(
+                    self._cache, self._state, slot, req_cache, req_logits,
+                    request.rng, *sampling,
+                )
         # NON-BLOCKING: no device sync here — the prefill/install dispatch
         # overlaps the decode stream, and step() syncs once per tick (its
         # np.asarray on the decoded tokens). prefill_s is therefore dispatch
@@ -675,13 +888,18 @@ class ServingEngine:
         now = time.perf_counter()
         request.status = RequestStatus.RUNNING
         request.slot = slot
+        request.pages_allocated = pages
         if request.replay_ids is not None and request.replay_pos < request.replay_ids.size:
             self._replay_slots[slot] = request
         request.admitted_at = now
         self.metrics.record_admit(
             request.request_id, slot, wait_s=now - request.submitted_at,
-            prefill_s=now - t0, bucket=bucket,
+            prefill_s=now - t0, bucket=bucket, pages=pages,
         )
+        if self.paged:
+            self.metrics.set_page_pool(
+                self._pool.num_pages - self._pool.reserved, self._pool.pages_in_use
+            )
         if self._obs_on:
             self._obs.async_instant(self._span_cat, request.request_id, "prefill",
                                     slot=slot, bucket=bucket)
@@ -693,6 +911,19 @@ class ServingEngine:
         self.scheduler.release(slot)
         self._replay_slots.pop(slot, None)
         self._state = self._jit_release(self._state, slot)
+        if self.paged:
+            # paged eviction: reset the slot's table to the trash page on
+            # device (a freed slot keeps decoding — stale entries would
+            # corrupt reallocated pages) and return the ids to the free
+            # list. No O(window) row zeroing — that is the point.
+            self._cache = self._jit_release_pages(self._cache, slot)
+            pages = self._slot_pages[slot]
+            if pages:
+                self._pool.release(pages)
+            self._slot_pages[slot] = None
+            self.metrics.set_page_pool(
+                self._pool.num_pages - self._pool.reserved, self._pool.pages_in_use
+            )
         request.status = status
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
@@ -819,13 +1050,16 @@ class ServingEngine:
                 self._expire_deadlines(time.perf_counter())
             if not self._draining:
                 with self._obs.span(self._span_admit):
-                    for slot, request in self.scheduler.pop_admissible():
+                    can_admit = self._can_admit_paged if self.paged else None
+                    for slot, request in self.scheduler.pop_admissible(can_admit):
                         self._admit(slot, request)
             self._maybe_inject_nan()
             occupied = list(self.scheduler.occupied())
             if self._obs_on:
                 self._obs.gauge_set(f"{self._obs_ns}.active_slots", len(occupied))
                 self._obs.gauge_set(f"{self._obs_ns}.queue_depth", self.scheduler.queue_depth)
+                if self.paged:
+                    self._obs.gauge_set(f"{self._obs_ns}.pages_in_use", self._pool.pages_in_use)
             if not occupied:
                 self._obs.span_end(self._span_tick)
                 return False
@@ -898,9 +1132,17 @@ class ServingEngine:
                 if not finite[slot]:
                     # containment: the token sampled from non-finite logits
                     # is garbage — never emitted — and the slot's
-                    # cache/state rows are zeroed so nothing non-finite
-                    # survives in the pool
-                    self._cache = self._jit_quarantine(self._cache, slot)
+                    # cache/state rows (dense) or pages (paged) are zeroed
+                    # so nothing non-finite survives in the pool
+                    if self.paged:
+                        row = np.zeros((self._pages_per_slot,), np.int32)
+                        pages = self._slot_pages[slot] or []
+                        row[: len(pages)] = pages
+                        self._cache = self._jit_quarantine(
+                            self._cache, slot, jnp.asarray(row)
+                        )
+                    else:
+                        self._cache = self._jit_quarantine(self._cache, slot)
                     self._evict(slot, request, "nonfinite_logits",
                                 status=RequestStatus.FAILED)
                     continue

@@ -43,6 +43,16 @@ Schema history:
     counters as 0 (an engine cannot fail over or shed by estimate); the
     reader normalizes v3-and-older snapshots with ``None`` — "not recorded"
     stays distinguishable from "none happened", the v2->v3 discipline.
+  * ``serving-metrics/v5`` — the paged-KV schema (docs/serving.md, paging
+    section): every snapshot carries a ``page_pool`` field — ``None`` on
+    engines running the dense pool (there IS no page pool), else a dict of
+    ``pages_total`` / ``pages_in_use`` / ``alloc_failures`` (head-of-line
+    blocking episodes — a request's reservation did not fit the free list) /
+    ``pages_per_request`` p50/p95 over the latency window. ``admit`` events
+    gain a ``pages`` field (the request's reservation) and the stream gains
+    ``alloc_failure`` events. Router snapshots report ``page_pool: None``
+    (pools are per-engine; the embedded replica sections carry the real
+    gauges). The reader normalizes pre-v5 snapshots with ``None``.
 """
 
 from __future__ import annotations
@@ -55,12 +65,13 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v4"
+SCHEMA = "serving-metrics/v5"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
     "serving-metrics/v3",
     "serving-metrics/v4",
+    "serving-metrics/v5",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
@@ -128,12 +139,16 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # 0 — "not recorded" must stay distinguishable from "none"
                 for k in _V3_COUNTERS:
                     snap.setdefault(k, None)
-            if schema != "serving-metrics/v4":
+            if schema in ("serving-metrics/v1", "serving-metrics/v2", "serving-metrics/v3"):
                 # pre-v4 writers had no multi-replica counters: same None
                 # discipline (a v3 engine never measured failovers — it did
                 # not run zero of them)
                 for k in _V4_FIELDS:
                     snap.setdefault(k, None)
+            if schema != "serving-metrics/v5":
+                # pre-v5 writers had no page pool; None also matches a v5
+                # DENSE engine's truthful "no pool exists"
+                snap.setdefault("page_pool", None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -216,8 +231,14 @@ class EngineMetrics(_JsonlMetrics):
     decode_seconds: float = 0.0
     prefill_seconds: float = 0.0
     queue_depth: int = 0
+    # page-pool gauges (serving-metrics/v5): pages_total None <=> the engine
+    # runs the dense pool and snapshots report page_pool: None
+    pages_total: Optional[int] = None
+    pages_in_use: int = 0
+    alloc_failures: int = 0  # head-of-line blocking episodes on the free list
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
+    _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _queue_waits: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _prefill_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _decode_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -234,7 +255,7 @@ class EngineMetrics(_JsonlMetrics):
 
     def record_admit(
         self, request_id: int, slot: int, wait_s: float, prefill_s: float,
-        bucket: Optional[int] = None,
+        bucket: Optional[int] = None, pages: Optional[int] = None,
     ) -> None:
         self.requests_admitted += 1
         self.prefills += 1
@@ -243,8 +264,27 @@ class EngineMetrics(_JsonlMetrics):
         self._queue_waits.append(wait_s)
         self._prefill_times.append(prefill_s)
         extra = {} if bucket is None else {"bucket": bucket}
+        if pages is not None:  # paged engines: the request's page reservation
+            self._pages_per_request.append(pages)
+            extra["pages"] = pages
         self._emit("admit", request_id=request_id, slot=slot,
                    wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6), **extra)
+
+    def record_alloc_failure(self, request_id: int, pages_needed: int, pages_free: int) -> None:
+        """One head-of-line BLOCKING EPISODE: the head request's page
+        reservation exceeded the free list (backpressure, not an error) — it
+        stays queued and retries every tick, but the engine reports each
+        blocked request once per episode, not once per tick, so a long block
+        cannot flood the JSONL stream or inflate the counter."""
+        self.alloc_failures += 1
+        self._emit("alloc_failure", request_id=request_id,
+                   pages_needed=pages_needed, pages_free=pages_free)
+
+    def set_page_pool(self, total: int, in_use: int) -> None:
+        """Refresh the page-pool occupancy gauges (called by the paged engine
+        after admissions and evictions change the free list)."""
+        self.pages_total = total
+        self.pages_in_use = in_use
 
     def record_decode_step(self, active_slots: int, seconds: float, tokens: int) -> None:
         self.decode_steps += 1
@@ -339,6 +379,17 @@ class EngineMetrics(_JsonlMetrics):
             "queue_wait_s": _latency_dict(self._queue_waits),
             "prefill_s": _latency_dict(self._prefill_times),
             "decode_step_s": _latency_dict(self._decode_times),
+            # v5: None on dense engines (no pool exists — same reading as a
+            # pre-v5 snapshot), real gauges on paged engines
+            "page_pool": None if self.pages_total is None else {
+                "pages_total": self.pages_total,
+                "pages_in_use": self.pages_in_use,
+                "alloc_failures": self.alloc_failures,
+                "pages_per_request": {
+                    k: v for k, v in _latency_dict(self._pages_per_request).items()
+                    if k in ("p50", "p95")
+                },
+            },
         }
         return snap
 
@@ -440,6 +491,9 @@ class RouterMetrics(_JsonlMetrics):
             "failovers": self.failovers,
             "shed_infeasible": self.shed_infeasible,
             "breaker_transitions": dict(sorted(self.breaker_transitions.items())),
+            # pools are per-engine: the embedded replica sections carry the
+            # real gauges, the router itself truthfully has none
+            "page_pool": None,
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),
             "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,

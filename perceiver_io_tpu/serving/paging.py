@@ -1,0 +1,142 @@
+"""KV page pool + per-slot page-table bookkeeping for the serving engine.
+
+This is the HOST side of the paged KV cache subsystem (ROADMAP item 1; the
+Ragged Paged Attention recipe of PAPERS.md): a refcounted free list over a
+fixed pool of physical KV pages, with fully deterministic allocation order —
+no clocks, no randomness, no hashing — so a replayed admission sequence
+allocates byte-identical page layouts (the discipline reliability/faults.py
+established for fault injection, applied to memory management).
+
+The DEVICE side lives in ops/paged_decode_kernel.py (``PagedKVCache``: the
+physical page pool + page-table arrays the compiled decode step reads) and
+models/core/perceiver_ar.py (``PagedPerceiverARCache``: install/release/ring
+arithmetic). The engine (serving/engine.py) composes the two: this allocator
+decides WHICH physical pages back WHICH slot, the device arrays mirror that
+decision.
+
+Allocation policy (docs/serving.md, "Paged KV cache"):
+
+  * page 0 is RESERVED as the shared trash page — free slots' table entries
+    point at it, their per-tick writes land in it, and it is never allocated;
+  * a request's admission reserves ``pages_for_request`` pages UP FRONT: the
+    covering prefill bucket plus the full ``max_new_tokens`` decode budget
+    (capped at the window). Admission is therefore the ONLY allocation point —
+    a mid-decode page fault cannot exist, so pool exhaustion surfaces
+    exclusively as admission backpressure (the existing ``queue_full``
+    contract) and never as a stalled or corrupted running slot;
+  * eviction returns the pages to the free list — O(pages) id pushes, no
+    O(window) row zeroing (quarantine of a NaN-contained slot additionally
+    zeroes the returned pages' contents on device: stale non-finite values
+    must never be gathered — even weight-0 — into a later tenant's softmax);
+  * the free list is kept SORTED ascending, so the allocator always hands out
+    the lowest free page ids: allocation order is a pure function of the
+    admission/eviction history.
+
+Refcounts exist for the cross-request prefix sharing ROADMAP item 3 builds on
+top (forking a shared prompt = retain + page-table copy); today every page
+has refcount 1 and ``retain`` simply has no second caller.
+
+Kill-switch: ``PERCEIVER_IO_TPU_DISABLE_PAGED_KV=1`` forces the dense pool
+even when an engine was configured with a page size (``paged_kv_enabled``),
+f64 greedy parity pinned both ways (tests/test_paging.py).
+"""
+
+from __future__ import annotations
+
+import os
+from heapq import heapify, heappop, heappush
+from typing import List, Sequence
+
+
+def paged_kv_enabled() -> bool:
+    """Kill-switch for the paged KV cache: PERCEIVER_IO_TPU_DISABLE_PAGED_KV=1
+    pins engines to the dense full-window slot pool (the pre-paging layout)
+    regardless of their ``kv_page_size`` knob. Checked at engine construction,
+    like the bucketed-prefill switch."""
+    return os.environ.get("PERCEIVER_IO_TPU_DISABLE_PAGED_KV", "0").lower() in ("0", "false", "")
+
+
+def pages_for_tokens(tokens: int, page_size: int) -> int:
+    """Pages needed to back ``tokens`` ring positions."""
+    return -(-tokens // page_size)
+
+
+def pages_for_request(bucket: int, max_new_tokens: int, window: int, page_size: int) -> int:
+    """A request's up-front page reservation: its covering prefill bucket plus
+    the whole generation budget, capped at the window (the ring wraps past it
+    back into already-reserved pages). Worst-case by construction — EOS may
+    finish earlier — which is exactly what makes admission the only
+    allocation point."""
+    return pages_for_tokens(min(bucket + max_new_tokens, window), page_size)
+
+
+class PagePool:
+    """Refcounted allocator over ``num_pages`` physical KV pages.
+
+    Deterministic: the free list is a min-heap over page ids, so ``allocate``
+    always returns the lowest free ids in ascending order — the same
+    admission/eviction history yields the same physical layout, which is what
+    lets chaos scenarios pin survivor token identity across contended runs
+    and the router's failover test pin exact page counts.
+    """
+
+    def __init__(self, num_pages: int, reserved: int = 1):
+        if num_pages < reserved + 1:
+            raise ValueError(
+                f"num_pages must exceed the {reserved} reserved page(s), got {num_pages}"
+            )
+        self.num_pages = num_pages
+        self.reserved = reserved
+        self._refcount = [0] * num_pages
+        self._free: List[int] = list(range(reserved, num_pages))
+        heapify(self._free)
+        self.total_allocations = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - self.reserved) - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        """Claim ``n`` pages (refcount 1 each), lowest ids first. Raises when
+        the pool cannot satisfy the request — callers gate on
+        ``can_allocate`` (the admission loop's head-of-line check), so a
+        raise here is a caller bug, not backpressure."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, have {len(self._free)} free "
+                f"(of {self.num_pages - self.reserved} allocatable)"
+            )
+        pages = [heappop(self._free) for _ in range(n)]
+        for p in pages:
+            self._refcount[p] = 1
+        self.total_allocations += n
+        return pages
+
+    def retain(self, pages: Sequence[int]) -> None:
+        """Add one reference to each page — the prefix-sharing primitive
+        (ROADMAP item 3: forking a shared prompt retains its pages and copies
+        the page table)."""
+        for p in pages:
+            if self._refcount[p] < 1:
+                raise ValueError(f"page {p} is not allocated")
+            self._refcount[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; pages reaching refcount 0 return to
+        the free list. Double-free raises (a slot's page list is consumed
+        exactly once, at eviction)."""
+        for p in pages:
+            if self._refcount[p] < 1:
+                raise ValueError(f"double free of page {p}")
+            self._refcount[p] -= 1
+            if self._refcount[p] == 0:
+                heappush(self._free, p)

@@ -70,7 +70,7 @@ Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v4``:
+phase tables from the single trace. Metrics are ``serving-metrics/v5``:
 router snapshots embed per-replica engine snapshots and the
 failover/shed/breaker counters.
 """
@@ -241,6 +241,8 @@ class ServingRouter:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_queue_depth: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
+        kv_page_size: Optional[int] = None,
+        num_kv_pages: Optional[int] = None,
         telemetry=None,
         handle_preemption: bool = False,
         # failover / breaker policy (docs/reliability.md failure-domain table)
@@ -298,6 +300,13 @@ class ServingRouter:
                     cache_dtype=cache_dtype,
                     prefill_buckets=prefill_buckets,
                     max_queue_depth=max_queue_depth,
+                    # paged KV knobs (docs/serving.md, paging section): each
+                    # replica owns its own page pool — failover replays
+                    # therefore allocate on the NEW replica's pool, at the
+                    # same covering bucket and generation budget, i.e.
+                    # exactly the victim's page count (pinned, test_router)
+                    kv_page_size=kv_page_size,
+                    num_kv_pages=num_kv_pages,
                     # per-replica engine event stream: a "{i}" placeholder in
                     # the template keeps the streams separate per replica
                     metrics_jsonl=replica_metrics_jsonl.format(i=i)
@@ -392,7 +401,7 @@ class ServingRouter:
         """Replicas eligible for NEW work: breaker CLOSED, least-loaded first
         (ties on the lowest index — deterministic placement)."""
         eligible = [r for r in self.replicas if r.breaker == BREAKER_CLOSED]
-        return sorted(eligible, key=lambda r: (r.engine.scheduler.load, r.rid))
+        return sorted(eligible, key=lambda r: (r.engine.load, r.rid))
 
     def _remaining_deadline(self, routed: RoutedRequest, now: float) -> Optional[float]:
         """Deadline budget LEFT for an engine hand-off: the engine enforces
@@ -435,7 +444,7 @@ class ServingRouter:
         saw_closed = False
         for r in self._serving_replicas():
             saw_closed = True
-            load_at_decision = r.engine.scheduler.load  # submit() bumps it
+            load_at_decision = r.engine.load  # submit() bumps it
             handle = r.engine.submit(
                 routed.prompt_ids, config=routed.config, rng=routed.rng,
                 deadline_s=self._remaining_deadline(routed, now),
@@ -828,7 +837,7 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v4 router snapshot with per-replica sections."""
+        """serving-metrics/v5 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:

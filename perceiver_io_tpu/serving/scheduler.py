@@ -72,6 +72,12 @@ class SlotScheduler(Generic[T]):
             if req is not None:
                 yield slot, req
 
+    def queued(self) -> Iterator[T]:
+        """Queued requests in FIFO order (read-only view) — the engine's
+        paged capacity estimate walks this to simulate head-of-line
+        admissions against the free page count (serving/engine.py)."""
+        return iter(self._queue)
+
     # ------------------------------------------------------------------ policy
     def enqueue(self, request: T) -> None:
         self._queue.append(request)
@@ -91,11 +97,20 @@ class SlotScheduler(Generic[T]):
             self._queue = kept
         return removed
 
-    def pop_admissible(self) -> Iterator[Tuple[int, T]]:
+    def pop_admissible(self, can_admit: Optional[Callable[[T], bool]] = None) -> Iterator[Tuple[int, T]]:
         """Yield (slot, request) admissions until slots or queue run out.
         The slot is claimed as soon as the pair is yielded, so the engine can
-        interleave prefill/install work with further admissions."""
+        interleave prefill/install work with further admissions.
+
+        ``can_admit`` adds a per-request resource gate (the paged engine's
+        free-page check): when the HEAD request fails it, admission stops —
+        head-of-line blocking on purpose, because skipping ahead would break
+        FIFO fairness and make page-allocation order depend on queue
+        composition rather than history (determinism contract,
+        serving/paging.py)."""
         while self._queue and self._free:
+            if can_admit is not None and not can_admit(self._queue[0]):
+                return
             slot = self._free.popleft()
             request = self._queue.popleft()
             self._slots[slot] = request

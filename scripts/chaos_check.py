@@ -29,6 +29,10 @@ exercised on every change, not just when production finds them:
   * ``queue_bound``        submits past ``max_queue_depth`` are REJECTED with
                            backpressure counters; ``drain()`` finishes active
                            slots and refuses new work
+  * ``paging_pool_exhaustion`` admissions past the KV page pool's capacity
+                           head-block then shed deterministically as
+                           queue_full (no crash, no request lost); survivors
+                           are f64 token-identical to an uncontended run
 
 Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
 
@@ -360,6 +364,59 @@ def check_queue_bound() -> dict:
     }
 
 
+def check_paging_pool_exhaustion() -> dict:
+    """Drive admissions past the KV page pool's capacity (docs/serving.md,
+    paging section): overflow submits are DETERMINISTICALLY rejected as
+    queue_full (backpressure, not a crash), a head-blocked request waits
+    (alloc_failure counted) and admits once pages free, no request is lost,
+    and every survivor's tokens are f64-identical to an uncontended run."""
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10, 11], [12, 13, 14], [15, 16]]
+
+        def run(num_kv_pages, max_queue_depth):
+            # page 2 over the 12-token window: every request here reserves 5
+            # pages (bucket 6 + 4 new); 11 pages (10 allocatable) fit two
+            # concurrent requests, the default pool fits everything
+            engine = _engine(model, params, num_slots=3, kv_page_size=2,
+                             num_kv_pages=num_kv_pages, max_queue_depth=max_queue_depth)
+            handles = [engine.submit(p, max_new_tokens=4) for p in prompts]
+            engine.run_until_drained(max_steps=300)
+            snap = engine.metrics.snapshot()
+            return ([h.status.value for h in handles],
+                    [h.result().tolist() for h in handles], snap)
+
+        # uncontended reference: default pool, unbounded queue
+        ref_statuses, ref_tokens, _ = run(num_kv_pages=None, max_queue_depth=None)
+        statuses, tokens, snap = run(num_kv_pages=11, max_queue_depth=1)
+        statuses2, tokens2, _ = run(num_kv_pages=11, max_queue_depth=1)  # repeat: deterministic
+
+    assert ref_statuses == ["finished"] * len(prompts)
+    finished = [i for i, s in enumerate(statuses) if s == "finished"]
+    rejected = [i for i, s in enumerate(statuses) if s == "rejected"]
+    survivors_identical = all(tokens[i] == ref_tokens[i] for i in finished)
+    accounted = (
+        snap["requests_submitted"]
+        == snap["requests_finished"] + snap["rejected"] + snap["timed_out"] + snap["failed"]
+    )
+    return {
+        "ok": (
+            len(rejected) > 0 and len(finished) >= 3
+            and (statuses, tokens) == (statuses2, tokens2)
+            and survivors_identical
+            and accounted
+            and snap["page_pool"]["alloc_failures"] >= 1
+            and snap["page_pool"]["pages_in_use"] == 0
+            and snap["rejected"] == len(rejected)
+        ),
+        "statuses": statuses,
+        "deterministic_repeat": (statuses, tokens) == (statuses2, tokens2),
+        "survivors_identical_to_uncontended": survivors_identical,
+        "alloc_failures": snap["page_pool"]["alloc_failures"],
+        "no_request_lost": accounted,
+    }
+
+
 def check_router_crash_failover() -> dict:
     """A replica crashed mid-decode loses nothing: the victim finishes
     token-identical (f64) to the fault-free run after failover, the survivor
@@ -516,6 +573,7 @@ CHECKS = {
     "serving_deadline": check_serving_deadline,
     "serving_nan": check_serving_nan,
     "queue_bound": check_queue_bound,
+    "paging_pool_exhaustion": check_paging_pool_exhaustion,
     "router_crash_failover": check_router_crash_failover,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,

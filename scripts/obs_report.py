@@ -202,6 +202,11 @@ def report_serving_metrics(path: str) -> Dict:
                       "timed_out", "failed", "tokens_generated", "decode_tokens_per_s",
                       "wall_tokens_per_s", "mean_slot_occupancy")
         }
+        # serving-metrics/v5 page pool (None: dense engine or pre-v5 stream)
+        out["page_pool"] = snap.get("page_pool")
+        alloc_failures = sum(1 for e in loaded["events"] if e.get("event") == "alloc_failure")
+        if alloc_failures:
+            out["alloc_failure_events"] = alloc_failures
     return out
 
 
@@ -272,6 +277,13 @@ def main(argv=None) -> Dict:
         print(f"\nserving metrics — {section['source']}: {section['events']} events")
         if "last_snapshot" in section:
             print(json.dumps(section["last_snapshot"], indent=1))
+        pool = section.get("page_pool")
+        if pool:
+            ppr = pool.get("pages_per_request") or {}
+            print("page pool: "
+                  f"{pool.get('pages_in_use')}/{pool.get('pages_total')} pages in use, "
+                  f"pages/request p50={ppr.get('p50')} p95={ppr.get('p95')}, "
+                  f"alloc failures={pool.get('alloc_failures')}")
     for section in report["train_metrics"]:
         print(f"\ntrain metrics — {section['source']}:")
         print(json.dumps({k: v for k, v in section.items() if k != "source"}, indent=1))

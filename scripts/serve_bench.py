@@ -15,6 +15,12 @@ admission arms drain ``max_new_tokens=1`` workloads (wall time is
 prefill-dominated); the decode arms drain long generations and report the
 metrics snapshot's ``decode_tokens_per_s``.
 
+``--page-size N`` runs the paged-KV capacity arm (ROADMAP item 1,
+docs/serving.md "Paged KV cache"): concurrent sessions per fixed KV-token
+budget and admission tokens/s, paged pool vs dense pool, interleaved
+median-of-``--page-repeats``; the block is merged into the ``--profile-out``
+artifact (BENCH_serving.json) with its run manifest.
+
 ``--replicas N`` runs the replica-scaling arm (ROADMAP item 2): a burst
 workload through a 1-replica and an N-replica ``ServingRouter`` (interleaved,
 median-of-``--replica-repeats``), reporting aggregate admission tokens/s
@@ -264,6 +270,128 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
     }
 
 
+def run_paging_capacity(model, config, params, page_size: int, num_slots: int,
+                        seed: int, repeats: int = 7, max_new: int = 8) -> dict:
+    """Acceptance arm (ROADMAP item 1 / docs/serving.md "Paged KV cache"):
+    CONCURRENT SESSIONS PER FIXED KV BUDGET, paged vs dense. The budget is
+    the dense pool's cross-attention KV backing — ``num_slots`` full windows
+    of tokens. The paged arm spends the exact same token budget on a page
+    pool (reserved trash page included, honestly inside the budget) and
+    raises its slot count to what the pool holds resident for this workload's
+    worst-case reservation; the dense arm cannot go past ``num_slots`` without
+    more HBM. Short-prompt workload (the ROADMAP's short-heavy traffic),
+    uniform ``max_new`` so reservations are uniform and waves are crisp.
+
+    Measured per arm, interleaved median-of-``repeats``: peak concurrent
+    RUNNING sessions, admission prompt tokens/s (wall to the LAST admission —
+    the burst-capacity dimension), and drain tokens/s. Fairness notes: the
+    paged arm's extra slots do cost self-attention cache and slot state
+    outside the CA-KV budget (max_latents rows per slot — reported, ~1/128th
+    of a window at the profile shape); greedy token identity across the arms
+    is pinned in float64 by tests/test_paging.py (this f32 bench records the
+    observed identity informationally)."""
+    from perceiver_io_tpu.serving import ServingEngine, pages_for_request
+    from perceiver_io_tpu.serving.engine import default_prefill_buckets
+
+    window = config.max_seq_len
+    budget_tokens = num_slots * window
+    num_pages = budget_tokens // page_size
+    rng = np.random.RandomState(seed)
+    short_hi = max(window // 8, 2)
+    buckets = default_prefill_buckets(window, config.max_latents)
+    covering = next(b for b in buckets if b >= short_hi)
+    need = pages_for_request(covering, max_new, window, page_size)
+    paged_slots = max((num_pages - 1) // need, 1)
+
+    k = 2 * max(paged_slots, num_slots)
+    prompts = [rng.randint(1, config.vocab_size, size=int(n)).tolist()
+               for n in rng.randint(2, short_hi + 1, size=k)]
+
+    # telemetry=False: ambient env must not record inside a TIMED arm
+    engines = {
+        "dense": ServingEngine(model, params, num_slots=num_slots, telemetry=False),
+        "paged": ServingEngine(model, params, num_slots=paged_slots,
+                               kv_page_size=page_size, num_kv_pages=num_pages,
+                               telemetry=False),
+    }
+
+    def one_pass(engine):
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, max_new_tokens=max_new, rng=jax.random.PRNGKey(i))
+                   for i, p in enumerate(prompts)]
+        peak = 0
+        while engine.step():
+            peak = max(peak, engine.scheduler.active_slots)
+        drain_wall = time.perf_counter() - t0
+        assert all(h.ok for h in handles)  # a degraded pass must not be timed
+        admit_wall = max(h.admitted_at for h in handles) - t0
+        engine.finished.clear()
+        return peak, admit_wall, drain_wall, [h.result().tolist() for h in handles]
+
+    for engine in engines.values():  # warmup compiles every covering bucket
+        one_pass(engine)
+    peaks = {n: [] for n in engines}
+    admit_walls = {n: [] for n in engines}
+    drain_walls = {n: [] for n in engines}
+    tokens_by_arm = {}
+    for _ in range(repeats):
+        for name, engine in engines.items():  # interleaved A/B
+            peak, admit, drain, toks = one_pass(engine)
+            peaks[name].append(peak)
+            admit_walls[name].append(admit)
+            drain_walls[name].append(drain)
+            tokens_by_arm[name] = toks
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    prompt_tokens = sum(len(p) for p in prompts)
+    new_tokens = max_new * len(prompts)
+    arms = {}
+    for name, engine in engines.items():
+        admit, drain = _median(admit_walls[name]), _median(drain_walls[name])
+        arms[name] = {
+            "slots": engine.num_slots,
+            "kv_budget_tokens": budget_tokens,
+            "peak_concurrent_sessions": _median(peaks[name]),
+            "admission_wall_seconds": round(admit, 4),
+            "admission_prompt_tokens_per_s": round(prompt_tokens / admit, 2)
+            if admit > 0 else 0.0,
+            "drain_wall_seconds": round(drain, 4),
+            "tokens_per_s": round(new_tokens / drain, 2) if drain > 0 else 0.0,
+            "decode_compilations": engine.decode_compilations,
+        }
+        if engine.paged:
+            snap = engine.metrics.snapshot()
+            arms[name]["num_kv_pages"] = num_pages
+            arms[name]["pages_per_request"] = snap["page_pool"]["pages_per_request"]
+            arms[name]["alloc_failures"] = snap["page_pool"]["alloc_failures"]
+        engine.close()
+    dense, paged = arms["dense"], arms["paged"]
+    return {
+        "page_size": page_size,
+        "window": window,
+        "kv_budget_tokens": budget_tokens,
+        "requests": len(prompts),
+        "max_new_tokens": max_new,
+        "prompt_tokens_per_pass": prompt_tokens,
+        # self-attention state the paged arm's extra slots cost OUTSIDE the
+        # CA-KV budget (honesty: the budget covers the dominant CA term only)
+        "sa_rows_per_slot": config.max_latents,
+        **{f"{n}_pool": a for n, a in arms.items()},
+        "concurrent_sessions_ratio": round(
+            paged["peak_concurrent_sessions"] / dense["peak_concurrent_sessions"], 3
+        ) if dense["peak_concurrent_sessions"] else 0.0,
+        "admission_speedup": round(
+            paged["admission_prompt_tokens_per_s"] / dense["admission_prompt_tokens_per_s"], 3
+        ) if dense["admission_prompt_tokens_per_s"] > 0 else 0.0,
+        # f64 identity is the pinned contract (tests/test_paging.py); this is
+        # the f32 observation on the LAST interleaved pass
+        "greedy_tokens_identical_f32": tokens_by_arm["dense"] == tokens_by_arm["paged"],
+    }
+
+
 def run_baseline(model, params, requests, warmup: bool):
     """Single-request serving: generate() per request, back-to-back, on the
     canonical padded shape (prompt left-padded to the full window)."""
@@ -496,6 +624,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--trace", default=None,
                     help="enable engine telemetry on the main workload and write "
                          "a Chrome trace (Perfetto-viewable) to this path")
+    ap.add_argument("--page-size", type=int, default=0,
+                    help="run the paged-KV capacity arm: concurrent sessions "
+                         "per fixed KV budget and admission tokens/s, paged "
+                         "(this page size) vs dense, interleaved median-of-7; "
+                         "the block lands in the --profile-out artifact "
+                         "(BENCH_serving.json)")
+    ap.add_argument("--page-repeats", type=int, default=7)
     ap.add_argument("--replicas", type=int, default=0,
                     help="run the replica-scaling arm: a burst workload through "
                          "a 1-replica vs N-replica ServingRouter (interleaved, "
@@ -507,6 +642,33 @@ def main(argv=None) -> dict:
         ap.error("--replicas needs N >= 2 (the arm compares N replicas against 1)")
 
     from perceiver_io_tpu.obs import write_run_manifest
+
+    def paging_arm(model, config, params):
+        block = run_paging_capacity(model, config, params, args.page_size,
+                                    args.slots, args.seed, repeats=args.page_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def merge_section(key, block, recorded_at):
+        """Merge one bench section into the tracked BENCH_serving.json
+        (other sections preserved) — the --replicas merge pattern."""
+        existing = {}
+        if os.path.exists(args.profile_out):
+            try:
+                with open(args.profile_out) as f:
+                    existing = json.load(f)
+            except (OSError, ValueError):
+                existing = {}  # unreadable artifact: rebuild around the new arm
+        existing[key] = block
+        existing[f"{key}_recorded_at"] = recorded_at
+        existing.setdefault("backend", jax.default_backend())
+        tmp = args.profile_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(existing, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.profile_out)
+        manifest = write_run_manifest(args.profile_out, config=vars(args))
+        print(f"merged {key} into {args.profile_out} (+ {manifest})", file=sys.stderr)
 
     def replica_arm(model, config, params):
         # burst workload ~6x one replica's capacity with UNIFORM generation
@@ -538,6 +700,8 @@ def main(argv=None) -> dict:
         }
         if args.replicas >= 2:
             result["replica_scaling"] = replica_arm(model, config, profile_params)
+        if args.page_size > 0:
+            result["paging"] = paging_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
@@ -585,24 +749,11 @@ def main(argv=None) -> dict:
         # the replica-scaling arm is part of the per-PR BENCH_serving.json
         # story even without --profile: merge it into the existing artifact
         # (other sections preserved) so the tracked file carries both
-        existing = {}
-        if os.path.exists(args.profile_out):
-            try:
-                with open(args.profile_out) as f:
-                    existing = json.load(f)
-            except (OSError, ValueError):
-                existing = {}  # unreadable artifact: rebuild around the new arm
-        existing["replica_scaling"] = scaling
-        existing["replica_scaling_recorded_at"] = result["recorded_at"]
-        existing.setdefault("backend", result["backend"])
-        tmp = args.profile_out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(existing, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.profile_out)
-        manifest = write_run_manifest(args.profile_out, config=vars(args))
-        print(f"merged replica_scaling into {args.profile_out} (+ {manifest})",
-              file=sys.stderr)
+        merge_section("replica_scaling", scaling, result["recorded_at"])
+    if args.page_size > 0:
+        paging = paging_arm(model, config, params)
+        result["paging"] = paging
+        merge_section("paging", paging, result["recorded_at"])
 
     tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
     with open(tmp, "w") as f:

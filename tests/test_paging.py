@@ -1,0 +1,460 @@
+"""Paged KV cache subsystem tests (docs/serving.md "Paged KV cache").
+
+The parity contract: a paged engine's greedy output is token-identical to
+``generate()``'s canonical full-window form — pinned in float64 across page
+sizes straddling every prefill-ladder rung (page < bucket, page = bucket,
+page not dividing the window) and with the kill-switch forcing the dense
+pool. The kernel contract: the paged Pallas kernel's dead-page skipping is
+BIT-identical to the skip-off kernel, and both match the XLA gather + masked
+softmax fallback applying the same (start, live) visibility bound. The churn
+contract: paging never adds decode programs (1, pinned) and every page
+returns to the free list.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import perceiver_io_tpu.ops.paged_decode_kernel as pdk
+from perceiver_io_tpu.generation.generate import GenerationConfig, generate
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.ops.position import apply_rope
+from perceiver_io_tpu.serving import PagePool, ServingEngine, pages_for_request
+from perceiver_io_tpu.serving.paging import pages_for_tokens
+
+VOCAB = 262
+WINDOW = 12
+LATENTS = 6
+
+# the ladder for this model is (6, 12); these straddle every rung:
+#   3 -> page < smallest bucket;  6 -> page == bucket;  5, 8 -> page does not
+#   divide the window (partial last page);  12 -> page == window (one page)
+PAGE_SIZES = (3, 5, 6, 8, 12)
+
+
+def _make_model(param_dtype=jnp.float32):
+    config = CausalSequenceModelConfig(
+        vocab_size=VOCAB, max_seq_len=WINDOW, max_latents=LATENTS, num_channels=16,
+        num_heads=2, num_self_attention_layers=2, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config, param_dtype=param_dtype)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (1, 8), 0, VOCAB)
+    params = jax.jit(model.init, static_argnames="prefix_len")(rng, prompt, prefix_len=2)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return _make_model()
+
+
+def _reference_tokens(model, params, prompt, config: GenerationConfig):
+    n = len(prompt)
+    ids = np.full((1, WINDOW), config.pad_token_id, np.int64)
+    pad = np.ones((1, WINDOW), bool)
+    ids[0, WINDOW - n:] = prompt
+    pad[0, WINDOW - n:] = False
+    out = generate(model, params, jnp.asarray(ids), num_latents=LATENTS,
+                   pad_mask=jnp.asarray(pad), config=config)
+    toks = np.asarray(out)[0, WINDOW:].tolist()
+    if config.eos_token_id is not None and config.eos_token_id in toks:
+        toks = toks[: toks.index(config.eos_token_id) + 1]
+    return toks
+
+
+# -------------------------------------------------------------------- pool
+def test_page_pool_deterministic_allocation_and_refcounts():
+    pool = PagePool(8)  # page 0 reserved (trash)
+    assert pool.free_pages == 7 and pool.pages_in_use == 0
+    a = pool.allocate(3)
+    assert a == [1, 2, 3]  # lowest ids first, ascending — deterministic
+    b = pool.allocate(2)
+    assert b == [4, 5] and pool.pages_in_use == 5
+    pool.release([2])
+    pool.release([1])
+    assert pool.allocate(2) == [1, 2]  # freed ids recycle lowest-first
+    # refcounts: retained pages survive one release
+    pool.retain([3])
+    pool.release([3])
+    assert 3 not in pool.allocate(2)  # still held -> [6, 7]
+    pool.release([3])
+    assert pool.allocate(1) == [3]
+    with pytest.raises(ValueError, match="double free"):
+        pool.release([5]); pool.release([5])
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.allocate(10)
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.retain([0])
+
+
+def test_pages_for_request_reservation():
+    # bucket + generation budget, capped at the window
+    assert pages_for_request(6, 4, WINDOW, 3) == pages_for_tokens(10, 3) == 4
+    assert pages_for_request(6, 100, WINDOW, 3) == 4  # capped at window=12
+    assert pages_for_request(12, 1, WINDOW, 5) == 3  # partial last page
+    assert pages_for_request(6, 1, WINDOW, 12) == 1
+
+
+# ------------------------------------------------------------------ parity
+@pytest.mark.parametrize("page_size", PAGE_SIZES)
+def test_paged_engine_matches_generate_across_page_sizes(x64, page_size):
+    """Acceptance: paged greedy engine output token-identical to generate()'s
+    canonical full-window form, in float64, for prompt lengths straddling
+    every prefill-ladder rung (1, bucket, bucket+1, window) — across page
+    sizes straddling every rung themselves."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    engine = ServingEngine(model, params, num_slots=3, kv_page_size=page_size)
+    assert engine.paged and engine.prefill_buckets == (LATENTS, WINDOW)
+    lengths = sorted({1, *(n for b in engine.prefill_buckets for n in (b, min(b + 1, WINDOW))), WINDOW})
+    prompts = [list(range(3, 3 + n)) for n in lengths]
+    handles = [engine.submit(p, max_new_tokens=5) for p in prompts]
+    engine.run_until_drained(max_steps=300)
+    for handle, prompt in zip(handles, prompts):
+        expected = _reference_tokens(model, params, prompt, GenerationConfig(max_new_tokens=5))
+        assert handle.result().tolist() == expected, f"len {len(prompt)} diverged at page {page_size}"
+        assert handle.pages_allocated == pages_for_request(
+            engine._bucket_for(len(prompt)), 5, WINDOW, page_size
+        )
+    assert engine._pool.pages_in_use == 0  # eviction returned every page
+
+
+def test_paged_kill_switch_forces_dense_and_matches(x64, monkeypatch):
+    """PERCEIVER_IO_TPU_DISABLE_PAGED_KV pins the dense pool even with
+    kv_page_size configured, and (greedy, float64) produces the same tokens."""
+    model, params = _make_model(param_dtype=jnp.float64)
+
+    def run(disable):
+        if disable:
+            monkeypatch.setenv("PERCEIVER_IO_TPU_DISABLE_PAGED_KV", "1")
+        else:
+            monkeypatch.delenv("PERCEIVER_IO_TPU_DISABLE_PAGED_KV", raising=False)
+        engine = ServingEngine(model, params, num_slots=2, kv_page_size=4)
+        handles = [engine.submit(p, max_new_tokens=4) for p in ([5, 6, 7], list(range(40, 49)))]
+        engine.run_until_drained(max_steps=100)
+        return [h.result().tolist() for h in handles], engine.paged
+
+    toks_paged, paged_on = run(False)
+    toks_dense, paged_off = run(True)
+    assert paged_on and not paged_off
+    assert toks_paged == toks_dense
+
+
+def test_paged_sampled_requests_reproducible(setup):
+    """Sampling shares the one paged decode program and stays reproducible
+    under its seed (the rng chain is untouched by the cache layout)."""
+    model, params = setup
+
+    def run(page_size=None):
+        kw = {} if page_size is None else {"kv_page_size": page_size}
+        engine = ServingEngine(model, params, num_slots=2, **kw)
+        h = engine.submit([1, 2, 3], rng=jax.random.PRNGKey(7),
+                          config=GenerationConfig(max_new_tokens=6, do_sample=True,
+                                                  temperature=0.8, top_k=50))
+        engine.run_until_drained(max_steps=100)
+        return h.result().tolist()
+
+    assert run(page_size=4) == run(page_size=4)  # seed-reproducible
+    assert run(page_size=4) == run(page_size=None)  # layout-invariant chain
+
+
+# ------------------------------------------------------------------- churn
+def test_paged_churn_compiles_decode_once(setup):
+    """Churn with paging on: one decode program ever, installs bounded by the
+    ladder, the release-pages/quarantine programs compile at most once, and
+    the free list is whole again after the storm."""
+    model, params = setup
+    engine = ServingEngine(model, params, num_slots=2, kv_page_size=4)
+    lengths = [2, 5, 9, 3, 7, 12, 4]
+    max_new = [3, 6, 2, 5, 4, 3, 7]
+    handles = []
+    for i, (n, m) in enumerate(zip(lengths, max_new)):
+        handles.append(engine.submit(list(range(1, n + 1)), max_new_tokens=m,
+                                     rng=jax.random.PRNGKey(i)))
+        engine.step()
+    engine.run_until_drained(max_steps=300)
+
+    assert all(h.done for h in handles)
+    assert [len(h.output_ids) for h in handles] == max_new
+    assert engine.scheduler.total_admissions == len(lengths)
+    assert engine.decode_compilations == 1  # THE invariant, paging included
+    assert engine.prefill_compilations <= len(engine.prefill_buckets)
+    assert engine._jit_install._cache_size() <= len(engine.prefill_buckets)
+    assert engine._jit_release_pages._cache_size() <= 1
+    assert engine._pool.pages_in_use == 0
+    assert all(p is None for p in engine._slot_pages)
+
+
+# ------------------------------------------------------------- backpressure
+def test_pool_exhaustion_is_queue_full_backpressure(setup):
+    """Pool exhaustion surfaces as the existing queue_full contract: the
+    head-of-line request WAITS (alloc_failure, not a crash) and is admitted
+    when pages free; past the bound, submits are REJECTED/queue_full."""
+    model, params = setup
+    # 12/4 = 3 pages per window; pool of 4 allocatable pages fits exactly one
+    # 7-token-prompt request (bucket 12 + budget -> 3 pages) at a time
+    engine = ServingEngine(model, params, num_slots=2, kv_page_size=4,
+                           num_kv_pages=5, max_queue_depth=1)
+    first = engine.submit(list(range(1, 8)), max_new_tokens=3)
+    engine.step()  # admitted: 3 of 4 pages in use
+    assert first.status.value == "running" and engine._pool.pages_in_use == 3
+    waiter = engine.submit(list(range(1, 8)), max_new_tokens=3)
+    engine.step()  # head-blocked on pages (2 slots free, 1 page free)
+    assert waiter.status.value == "queued"
+    assert engine.metrics.alloc_failures >= 1
+    overflow = engine.submit(list(range(1, 8)), max_new_tokens=3)  # past bound
+    assert overflow.done and overflow.finish_reason == "queue_full"
+    engine.run_until_drained(max_steps=100)
+    assert first.ok and waiter.ok  # the waiter was admitted once pages freed
+    snap = engine.metrics.snapshot()
+    assert snap["page_pool"]["alloc_failures"] >= 1
+    assert snap["page_pool"]["pages_in_use"] == 0
+    assert snap["rejected"] == 1
+
+
+def test_paged_engine_rejects_undersized_pool(setup):
+    model, params = setup
+    with pytest.raises(ValueError, match="num_kv_pages"):
+        ServingEngine(model, params, num_slots=1, kv_page_size=4, num_kv_pages=3)
+    with pytest.raises(ValueError, match="kv_page_size"):
+        ServingEngine(model, params, num_slots=1, kv_page_size=WINDOW + 1)
+
+
+# ------------------------------------------------------------- containment
+def test_paged_nan_quarantine_zeroes_and_frees_pages(setup):
+    """Containment under paging: the poisoned slot is evicted FAILED, its
+    pages are ZEROED before returning to the free list (stale NaN gathered at
+    weight 0 would poison a later tenant's softmax), and the survivor decodes
+    on token-identical."""
+    from perceiver_io_tpu.reliability import armed
+
+    model, params = setup
+    ref_engine = ServingEngine(model, params, num_slots=2, kv_page_size=4)
+    ref = ref_engine.submit([4, 5, 6], max_new_tokens=5)
+    ref_engine.run_until_drained(max_steps=100)
+
+    engine = ServingEngine(model, params, num_slots=2, kv_page_size=4)
+    poisoned = engine.submit([1, 2, 3], max_new_tokens=6)
+    survivor = engine.submit([4, 5, 6], max_new_tokens=5)
+    engine.step()
+    with armed("serving.nan", slot=poisoned.slot):
+        engine.step()
+    engine.run_until_drained(max_steps=100)
+
+    assert poisoned.status.value == "failed"
+    assert survivor.ok and survivor.result().tolist() == ref.result().tolist()
+    assert engine._pool.pages_in_use == 0
+    # nothing non-finite survives anywhere in the page pool
+    assert np.isfinite(np.asarray(engine._cache.ca.kp)).all()
+    assert np.isfinite(np.asarray(engine._cache.ca.vp)).all()
+    assert engine.decode_compilations == 1
+
+
+# ----------------------------------------------------------------- metrics
+def test_metrics_v5_page_pool_and_reader(tmp_path, setup):
+    model, params = setup
+    path = tmp_path / "paged.jsonl"
+    engine = ServingEngine(model, params, num_slots=2, kv_page_size=4,
+                           metrics_jsonl=str(path))
+    engine.submit([1, 2, 3], max_new_tokens=3)
+    engine.run_until_drained(max_steps=50)
+    snap = engine.metrics.write_snapshot()
+    engine.close()
+    pool = snap["page_pool"]
+    assert pool["pages_total"] == 2 * pages_for_tokens(WINDOW, 4)
+    assert pool["pages_in_use"] == 0 and pool["alloc_failures"] == 0
+    assert pool["pages_per_request"]["p50"] == 3.0  # bucket 6 + 3 new -> ceil(9/4)
+
+    from perceiver_io_tpu.serving import load_metrics_jsonl
+
+    got = load_metrics_jsonl(str(path))
+    admit = next(e for e in got["events"] if e["event"] == "admit")
+    assert admit["pages"] == 3
+    assert got["snapshots"][-1]["page_pool"] == pool
+
+    # pre-v5 snapshots normalize page_pool to None; unknown schemas still raise
+    v4 = tmp_path / "v4.jsonl"
+    v4.write_text(json.dumps({
+        "event": "snapshot", "ts": 1.0, "schema": "serving-metrics/v4",
+        "num_slots": 2, "tokens_generated": 5, "failovers": 0,
+    }) + "\n")
+    old = load_metrics_jsonl(str(v4))["snapshots"][0]
+    assert old["page_pool"] is None and old["failovers"] == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v99"}) + "\n")
+    with pytest.raises(ValueError, match="unknown metrics schema"):
+        load_metrics_jsonl(str(bad))
+
+
+# ------------------------------------------------------------------ kernel
+def paged_xla_reference(q, kp, vp, table, start, live, ang, window):
+    """Gather-through-the-table masked softmax — the fallback formulation the
+    kernel must match (same (start, live) visibility bound)."""
+    b, h, n_q, d = q.shape
+    k = kp[table].reshape(b, -1, h * d)
+    v = vp[table].reshape(b, -1, h * d)
+    n_phys = k.shape[1]
+    kh = apply_rope(k.reshape(b, n_phys, h, d).transpose(0, 2, 1, 3).astype(jnp.float32), ang)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kh)
+    vis = pdk.paged_visibility(start, live, window, n_phys)
+    s = jnp.where(vis[:, None, None, :], s, -jnp.inf)
+    vh = v.reshape(b, n_phys, h, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vh)
+
+
+def _kernel_inputs(b, h, d, window, ps, n_pool, seed=0):
+    rng = lambda i: jax.random.PRNGKey(seed + i)
+    p = -(-window // ps)
+    q = jax.random.normal(rng(0), (b, h, 1, d)) * 0.3
+    kp = jax.random.normal(rng(1), (n_pool, ps, h * d)) * 0.3
+    vp = jax.random.normal(rng(2), (n_pool, ps, h * d)) * 0.3
+    # distinct pages per row (the allocator invariant), deliberately shuffled
+    perm = jax.random.permutation(rng(3), n_pool - 1)[: b * p] + 1
+    table = jnp.asarray(np.asarray(perm).reshape(b, p), jnp.int32)
+    ang = jnp.repeat(jax.random.normal(rng(4), (b, p * ps, d // 2)) * 0.5, 2, axis=-1)
+    return q, kp, vp, table, ang
+
+
+@pytest.mark.parametrize(
+    "window,ps,starts,lives",
+    [
+        (256, 64, (0, 100, 255), (256, 40, 1)),     # saturated, mid, minimal
+        (200, 64, (8, 72, 199), (200, 130, 64)),    # page does not divide window
+        (256, 256, (0, 17, 128), (256, 100, 7)),    # one page per slot
+    ],
+)
+def test_paged_kernel_matches_gather_reference_interpret(window, ps, starts, lives):
+    """The paged kernel (interpret mode) matches the XLA gather + masked
+    softmax fallback across ring offsets and live counts, including wrapped
+    live intervals and a partial last page."""
+    b, h, d = 3, 2, 32
+    q, kp, vp, table, ang = _kernel_inputs(b, h, d, window, ps, n_pool=3 * (-(-window // ps)) + 2)
+    start = jnp.asarray(starts, jnp.int32)
+    live = jnp.asarray(lives, jnp.int32)
+    out = pdk.fused_paged_decode_attention(
+        q, kp, vp, table, start, live, ang, window, interpret=True
+    )
+    ref = paged_xla_reference(q, kp, vp, table, start, live, ang, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_paged_kernel_dead_page_skip_bitwise_interpret():
+    """Acceptance (paged ragged decode): skipping pages with no live position
+    leaves the flash state BIT-identical to fetching and masking them — the
+    skipped pages contribute prob = 0 / scale = 1 exactly."""
+    window, ps = 256, 32
+    b, h, d = 3, 2, 32
+    q, kp, vp, table, ang = _kernel_inputs(b, h, d, window, ps, n_pool=3 * 8 + 2, seed=9)
+    # unsaturated rows: live < window with start == live (the engine's
+    # admission layout — dead tail pages), plus one saturated row
+    start = jnp.asarray([40, 200, 0], jnp.int32)
+    live = jnp.asarray([40, 200, 256], jnp.int32)
+    skip = pdk.fused_paged_decode_attention(
+        q, kp, vp, table, start, live, ang, window, skip_dead_pages=True, interpret=True
+    )
+    full = pdk.fused_paged_decode_attention(
+        q, kp, vp, table, start, live, ang, window, skip_dead_pages=False, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(skip), np.asarray(full))
+
+
+def test_paged_decode_supported_gates():
+    import os
+
+    if jax.default_backend() != "tpu":
+        assert not pdk.paged_decode_supported(128, 512, 512)
+    os.environ["PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL"] = "1"
+    try:
+        assert not pdk.paged_decode_supported(128, 512, 512)
+    finally:
+        del os.environ["PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL"]
+
+
+def test_paged_engine_with_kernel_forced_matches_fallback(setup, monkeypatch):
+    """Force the paged kernel (interpret mode) through the real engine decode:
+    tokens must match the XLA-fallback engine exactly — the full-stack form
+    of the kernel/fallback equivalence."""
+    model, params = setup
+    real = pdk.fused_paged_decode_attention
+
+    def run(force):
+        if force:
+            monkeypatch.setattr(pdk, "paged_decode_supported", lambda *a, **kw: True)
+            monkeypatch.setattr(pdk, "fused_paged_decode_attention",
+                                lambda *a, **kw: real(*a, **{**kw, "interpret": True}))
+        else:
+            monkeypatch.setattr(pdk, "paged_decode_supported", lambda *a, **kw: False)
+        engine = ServingEngine(model, params, num_slots=2, kv_page_size=4)
+        handles = [engine.submit(p, max_new_tokens=5)
+                   for p in ([7, 3, 9], list(range(40, 49)))]
+        engine.run_until_drained(max_steps=100)
+        return [h.result().tolist() for h in handles]
+
+    fallback = run(False)
+    kernel = run(True)
+    assert kernel == fallback
+
+
+# -------------------------------------------------------------- serve_bench
+def test_serve_bench_paging_arm_smoke(tmp_path):
+    """CI satellite: ``serve_bench --page-size`` writes the paging section —
+    concurrent sessions per fixed KV budget, paged vs dense — into the
+    BENCH_serving.json artifact, with both arms compiling one decode program
+    and the paged pool living inside the dense arm's token budget."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_paging_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "serve_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = tmp_path / "SERVE_BENCH.json"
+    profile_out = tmp_path / "BENCH_serving.json"
+    result = mod.main([
+        "--preset", "tiny", "--slots", "2", "--requests", "3",
+        "--page-size", "8", "--page-repeats", "2", "--no-baseline",
+        "--out", str(out), "--profile-out", str(profile_out),
+    ])
+    paging = result["paging"]
+    assert paging["page_size"] == 8
+    assert paging["dense_pool"]["kv_budget_tokens"] == paging["paged_pool"]["kv_budget_tokens"]
+    assert paging["paged_pool"]["num_kv_pages"] * 8 <= paging["kv_budget_tokens"]
+    assert paging["dense_pool"]["decode_compilations"] == 1
+    assert paging["paged_pool"]["decode_compilations"] == 1
+    assert paging["paged_pool"]["peak_concurrent_sessions"] >= 1
+    assert paging["concurrent_sessions_ratio"] > 0
+    # merged into the tracked artifact alongside any other sections
+    on_disk = json.loads(profile_out.read_text())
+    assert on_disk["paging"]["page_size"] == 8
+    assert (tmp_path / "BENCH_serving.manifest.json").exists()
+
+
+# ------------------------------------------------------------------ rewind
+def test_paged_rewind_matches_dense_rewind_contract(setup):
+    """PagedPerceiverARCache.rewind un-appends exactly: decode k tokens,
+    rewind k, decode again — the logits stream repeats (the speculative
+    verification contract the dense cache already honors)."""
+    model, params = setup
+    engine = ServingEngine(model, params, num_slots=1, kv_page_size=4)
+    h = engine.submit([1, 2, 3, 4], max_new_tokens=1)
+    engine.step()
+    engine.run_until_drained(max_steps=20)
+    assert h.ok
+    # drive the model method directly on the engine's (now free) pool: install
+    # left the slot released, so re-admit one request and snapshot the cache
+    h2 = engine.submit([5, 6, 7], max_new_tokens=8)
+    engine.step_dispatch()
+    engine.step_harvest()
+    cache = engine._cache
+    tok = jnp.asarray([[9]], jnp.int32)
+    logits1, cache1 = model.apply(params, tok, cache, method=CausalSequenceModel.decode_step_paged)
+    cache_rw = cache1.rewind(1)
+    logits2, _ = model.apply(params, tok, cache_rw, method=CausalSequenceModel.decode_step_paged)
+    np.testing.assert_array_equal(np.asarray(logits1), np.asarray(logits2))

@@ -1,6 +1,6 @@
 """Multi-replica router tests: dispatch parity, deterministic failover
 (token-identity at bucket boundaries, float64), circuit-breaker state
-machine, SLO shedding, churn/compile bounds, serving-metrics/v4, and the
+machine, SLO shedding, churn/compile bounds, serving-metrics/v5, and the
 SIGTERM/SIGINT graceful drain.
 
 The failover contract (docs/serving.md, router section): after a replica is
@@ -83,7 +83,7 @@ def test_router_greedy_parity_mixed_lengths(x64):
         assert handle.failovers == 0
     # load-based dispatch actually spread the work
     snap = router.snapshot()
-    assert snap["schema"] == "serving-metrics/v4"
+    assert snap["schema"] == "serving-metrics/v5"
     assert all(s["requests_admitted"] > 0 for s in snap["replicas"].values())
     assert snap["failovers"] == 0 and snap["breaker_transitions"] == {}
     router.close()
@@ -122,6 +122,43 @@ def test_failover_token_identity_at_bucket_boundaries(x64):
         assert all(r.breaker == BREAKER_CLOSED for r in router.replicas)
     snap = router.snapshot()
     assert snap["failovers"] == len(lengths)
+    router.close()
+
+
+def test_paged_failover_replays_at_victims_page_count(x64):
+    """Satellite (docs/serving.md, paging section): with paging on, a
+    failover replay re-prefills at the victim's covering bucket and allocates
+    EXACTLY the victim's page reservation on the new replica — same bucket +
+    same generation budget, never a dense-window fallback — while the
+    continuation stays f64 token-identical to the dense uninterrupted run."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompt, max_new = [7, 3, 9], 2
+    expected = _engine_reference(model, params, [prompt], [max_new])[0]
+
+    # page 3 over window 12: a full-window reservation would be 4 pages; this
+    # request's (bucket 6 + 2 new -> ceil(8/3)) is 3 — the counts distinguish
+    # the replay path from any dense-window fallback
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           kv_page_size=3, breaker_cooldown_ticks=1)
+    assert all(r.engine.paged for r in router.replicas)
+    victim = router.submit(prompt, max_new_tokens=max_new)
+    router.step()  # one token decoded: the crash is mid-request
+    victim_pages = victim._engine_handle.pages_allocated
+    assert victim_pages == 3  # < the 4-page full-window reservation
+    victim_replica = victim.replica
+    with armed("replica.crash", slot=victim_replica, times=1):
+        router.run_until_drained(max_steps=300)
+    assert victim.ok and victim.failovers == 1
+    assert victim.result().tolist() == expected  # layout + failover invisible
+    assert victim.replica != victim_replica
+    # the replayed admission reserved exactly the victim's page count on the
+    # NEW replica's own pool, and eviction returned every page
+    assert victim._engine_handle.pages_allocated == victim_pages
+    new_engine = router.replicas[victim.replica].engine
+    assert new_engine._pool.pages_in_use == 0
+    snap = router.snapshot()
+    assert snap["page_pool"] is None  # router has no pool of its own
+    assert snap["replicas"][f"r{victim.replica}"]["page_pool"]["pages_in_use"] == 0
     router.close()
 
 
@@ -436,12 +473,12 @@ def test_router_metrics_v4_jsonl_and_reader(tmp_path):
     events = {e["event"] for e in got["events"]}
     assert {"submit", "dispatch", "failover", "breaker", "shed", "finish", "snapshot"} <= events
     snap = got["snapshots"][0]
-    assert snap["schema"] == "serving-metrics/v4"
+    assert snap["schema"] == "serving-metrics/v5"
     assert snap["failovers"] == 1 and snap["shed_infeasible"] == 1
     assert snap["breaker_transitions"] == {"closed->open": 1}
     assert snap["tokens_generated"] == 1  # aggregated over replica sections
     assert set(snap["replicas"]) == {"r0", "r1"}
-    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v4"
+    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v5"
 
     bad = tmp_path / "bad.jsonl"
     bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v9"}) + "\n")
