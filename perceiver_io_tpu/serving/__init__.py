@@ -29,7 +29,7 @@ from perceiver_io_tpu.serving.paging import (
     pages_for_tokens,
 )
 from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
-from perceiver_io_tpu.serving.scheduler import SlotScheduler
+from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
 __all__ = [
     "EngineMetrics",
@@ -37,6 +37,7 @@ __all__ = [
     "paged_kv_enabled",
     "pages_for_request",
     "pages_for_tokens",
+    "preemption_enabled",
     "RequestStatus",
     "RoutedRequest",
     "RouterMetrics",

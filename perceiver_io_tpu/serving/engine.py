@@ -57,7 +57,7 @@ Telemetry (docs/observability.md): ``ServingEngine(telemetry=...)`` (or the
 ``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans per tick (admit /
 prefill dispatch / install / decode dispatch / sample-sync / evict),
 per-request lifecycle spans keyed by request id (joinable against the
-serving-metrics/v5 JSONL events), and a compile watchdog that flags any
+serving-metrics/v6 JSONL events), and a compile watchdog that flags any
 program count growing past the churn-never-recompiles budgets at runtime.
 Off by default; the disabled path holds the shared no-op recorder and the
 greedy-parity and compile-count pins run through it unchanged.
@@ -77,6 +77,24 @@ by construction). Free slots' tables point at the reserved trash page; the
 churn contract is unchanged (one decode program, <= one install program per
 bucket, pinned).
 
+Priority classes + preemption (docs/serving.md "Priority classes &
+preemption"): ``submit(..., priority=k)`` places a request in class ``k``
+(small int, default 0, higher wins); the scheduler admits by (effective
+priority desc, submit order) with an optional anti-starvation aging rule
+(``priority_aging_ticks`` — a queued request rises one class per N ticks
+waited; tick-counted, no clocks). When the admission-order head is blocked
+on pages or slots, the engine PREEMPTS the cheapest set of strictly-lower-
+class running slots that frees enough: each victim is evicted through the
+existing release/release-pages programs into the non-terminal ``PREEMPTED``
+status, its pages return to the pool, and its continuation re-queues at its
+original priority (and original seniority) as a prompt + emitted-tokens
+REPLAY — the same forced-decode mux the router's failover uses, now
+intra-engine, so the resumed output is f64 token-identical to an
+uncontended run (rng chain included) and a preempt/resume cycle compiles
+NOTHING new. Victim selection is a pure function of (priority, admission
+order, page count); each request survives at most ``max_preemptions``
+preemptions, then runs to completion untouchable (no livelock).
+
 Kill-switches: ``PERCEIVER_IO_TPU_DISABLE_BUCKETED_PREFILL=1`` pins the
 ladder at the single full-window bucket (the PR-1 behavior);
 ``PERCEIVER_IO_TPU_DISABLE_RAGGED_DECODE=1`` disables live-length masking
@@ -84,7 +102,11 @@ and block skipping (pad masking alone; under paging only the kernel's
 dead-page skip — the visibility bound is load-bearing there);
 ``PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL=1`` disables the fused kernel;
 ``PERCEIVER_IO_TPU_DISABLE_PAGED_KV=1`` forces the dense pool even when
-``kv_page_size`` is configured (f64 greedy parity pinned both ways).
+``kv_page_size`` is configured (f64 greedy parity pinned both ways);
+``PERCEIVER_IO_TPU_DISABLE_PREEMPTION=1`` restores strict submit-order FIFO
+(priorities ignored, no aging, no preemption — behavior bit-identical to
+the pre-priority engine, pinned by the ``preempt_disabled_inert`` chaos
+scenario).
 
 Greedy engine output is token-identical to ``generate()`` on the same
 canonical form (tests/test_serving.py pins this in float64); sampled output
@@ -117,7 +139,7 @@ from perceiver_io_tpu.reliability.preemption import (
 )
 from perceiver_io_tpu.serving.metrics import EngineMetrics
 from perceiver_io_tpu.serving.paging import PagePool, paged_kv_enabled, pages_for_request
-from perceiver_io_tpu.serving.scheduler import SlotScheduler
+from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
 
 class SlotState(flax.struct.PyTreeNode):
@@ -158,6 +180,9 @@ class SlotState(flax.struct.PyTreeNode):
 class RequestStatus(str, Enum):
     QUEUED = "queued"
     RUNNING = "running"
+    # NON-terminal: evicted from its slot under priority pressure, re-queued
+    # at its original priority awaiting replay re-admission (docs/serving.md)
+    PREEMPTED = "preempted"
     FINISHED = "finished"  # completed normally (eos / length)
     REJECTED = "rejected"  # refused admission (queue bound, prompt, draining)
     TIMED_OUT = "timed_out"  # deadline expired, queued or running
@@ -180,11 +205,19 @@ class ServedRequest:
     rng: jax.Array
     status: RequestStatus = RequestStatus.QUEUED
     slot: Optional[int] = None
+    # priority class (higher wins) and how many times this request has been
+    # preempted — at the engine's max_preemptions it becomes untouchable
+    priority: int = 0
+    preemptions: int = 0
     output_ids: List[int] = field(default_factory=list)
     # "eos" | "length" | rejection/expiry/failure detail ("queue_full",
     # "prompt_too_long", "draining", "deadline", "nonfinite_logits")
     finish_reason: Optional[str] = None
     submitted_at: float = 0.0
+    # the instant this request last ENTERED the queue (submit, or the latest
+    # preemption): the per-class queue-wait stats measure the current wait,
+    # not a sum over preemption cycles
+    enqueued_at: float = 0.0
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
     deadline_s: Optional[float] = None  # TTL from submit; enforced at ticks
@@ -288,6 +321,8 @@ class ServingEngine:
         handle_preemption: bool = False,
         kv_page_size: Optional[int] = None,
         num_kv_pages: Optional[int] = None,
+        priority_aging_ticks: Optional[int] = None,
+        max_preemptions: int = 2,
     ):
         self.model = model
         self.params = params
@@ -305,11 +340,22 @@ class ServingEngine:
         self._span_sample_sync = f"{obs_ns}.sample_sync"
         self._span_evict = f"{obs_ns}.evict"
         self.cache_dtype = cache_dtype if cache_dtype is not None else _cache_dtype(model)
-        self.scheduler: SlotScheduler[ServedRequest] = SlotScheduler(num_slots)
+        # Priority classes + engine-local preemption (docs/serving.md): the
+        # kill-switch disables the WHOLE feature — queue order reverts to
+        # strict submit-order FIFO and running slots are never preempted, so
+        # behavior is bit-identical to the pre-priority engine (chaos-pinned).
+        if max_preemptions < 0:
+            raise ValueError(f"max_preemptions must be >= 0, got {max_preemptions}")
+        self.priority_preemption = preemption_enabled()
+        self.max_preemptions = max_preemptions
+        self.priority_aging_ticks = priority_aging_ticks if self.priority_preemption else None
+        self.scheduler: SlotScheduler[ServedRequest] = SlotScheduler(
+            num_slots, aging_ticks=self.priority_aging_ticks
+        )
         self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
         # unified telemetry (docs/observability.md): phase spans per tick,
         # per-request lifecycle spans keyed by request id (joinable against
-        # the serving-metrics/v5 events carrying the same request_id), and a
+        # the serving-metrics/v6 events carrying the same request_id), and a
         # compile watchdog policing the churn-never-recompiles invariant at
         # runtime. Off by default: ``telemetry=None`` defers to the
         # PERCEIVER_IO_TPU_TELEMETRY env, and the disabled surface is the
@@ -732,12 +778,17 @@ class ServingEngine:
         rng: Optional[jax.Array] = None,
         deadline_s: Optional[float] = None,
         replay_ids: Optional[Sequence[int]] = None,
+        priority: int = 0,
         **kwargs,
     ) -> ServedRequest:
         """Queue one request; returns its handle. ``config``/kwargs follow
         ``generate()``'s convention (pass one or the other). ``deadline_s``
         is a TTL from now (falls back to the engine's ``default_deadline_s``);
         an expired request is evicted ``TIMED_OUT`` at the next tick.
+        ``priority`` is the request's class (small int, default 0, higher
+        wins): admission is FIFO within a class, higher classes first, and a
+        class-k head blocked on pages/slots may preempt strictly-lower-class
+        running work (docs/serving.md; inert under the kill-switch).
         ``replay_ids`` force-feeds a known token stream through the decode
         step after prefill — deterministic state reconstruction for router
         failover (the replayed tokens are re-emitted into ``output_ids`` and
@@ -768,19 +819,23 @@ class ServingEngine:
             # SlotState.rng is a raw (B, 2) uint32 buffer (rows of one batched
             # array cannot hold typed key objects); accept both key flavors
             rng = jax.random.key_data(rng)
+        now = time.perf_counter()
         request = ServedRequest(
             request_id=next(self._ids),
             prompt_ids=prompt,
             config=config,
             rng=rng,
-            submitted_at=time.perf_counter(),
+            priority=int(priority),
+            submitted_at=now,
+            enqueued_at=now,
             deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
             replay_ids=np.asarray(replay_ids, np.int32).reshape(-1)
             if replay_ids is not None and len(replay_ids) else None,
         )
         if request.deadline_s is not None:
             self._deadlines_seen = True
-        self.metrics.record_submit(request.request_id, int(prompt.size))
+        self.metrics.record_submit(request.request_id, int(prompt.size),
+                                   priority=request.priority)
         if self._obs_on:
             # lifecycle span: submit -> queued -> prefill -> ... -> terminal,
             # keyed by request id (the join key against serving-metrics events)
@@ -802,7 +857,13 @@ class ServingEngine:
         if self.max_queue_depth is not None and self.load >= self.max_queue_depth:
             return self._reject(request, "queue_full")
         self._requests[request.request_id] = request
-        self.scheduler.enqueue(request)
+        # seq = the monotone request id, so FIFO-within-class is submit order
+        # and a later preemption re-queue resumes the same seniority; with
+        # the feature killed the class collapses to 0 — strict global FIFO,
+        # bit-identical to the pre-priority engine
+        self.scheduler.enqueue(request,
+                               priority=request.priority if self.priority_preemption else 0,
+                               seq=request.request_id)
         return request
 
     def _reject(self, request: ServedRequest, reason: str) -> ServedRequest:
@@ -886,6 +947,7 @@ class ServingEngine:
         # np.asarray on the decoded tokens). prefill_s is therefore dispatch
         # time; device prefill cost lands in the next decode_step sync.
         now = time.perf_counter()
+        resumed = request.status is RequestStatus.PREEMPTED
         request.status = RequestStatus.RUNNING
         request.slot = slot
         request.pages_allocated = pages
@@ -893,8 +955,9 @@ class ServingEngine:
             self._replay_slots[slot] = request
         request.admitted_at = now
         self.metrics.record_admit(
-            request.request_id, slot, wait_s=now - request.submitted_at,
+            request.request_id, slot, wait_s=now - request.enqueued_at,
             prefill_s=now - t0, bucket=bucket, pages=pages,
+            priority=request.priority, preempted_replay=resumed,
         )
         if self.paged:
             self.metrics.set_page_pool(
@@ -970,11 +1033,149 @@ class ServingEngine:
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
         self.finished.append(request)
-        self.metrics.record_evict_queued(request_id, reason, status=status.value)
+        self.metrics.record_evict_queued(request_id, reason, status=status.value,
+                                         new_tokens=len(request.output_ids))
         if self._obs_on:
             self._obs.async_end(self._span_cat, request_id,
-                                status=status.value, reason=reason, new_tokens=0)
+                                status=status.value, reason=reason,
+                                new_tokens=len(request.output_ids))
         return request
+
+    # -------------------------------------------------------------- preemption
+    def _select_victims(self, request: ServedRequest) -> List:
+        """The cheapest set of strictly-lower-class running slots whose
+        eviction lets ``request`` (the blocked admission-order head) admit —
+        a PURE function of (priority, admission order, page count), so chaos
+        scenarios pin exact victim identity across repeat runs:
+
+          * candidates: running requests with base priority STRICTLY below
+            the head's base priority (aging raises queue rank, never
+            preemption eligibility) that still have preemption budget left
+            (``preemptions < max_preemptions`` — past it a request runs to
+            completion untouchable, so no livelock);
+          * order: lowest class first; within a class the LARGEST page
+            reservation first (fewest victims free the most pages), then the
+            youngest admission (highest request id — least replay work lost);
+          * take greedily until the head's missing slot and missing pages are
+            covered; if the full candidate set still cannot cover them,
+            preempt NOBODY (a useless eviction would burn a replay for
+            nothing and still not admit the head).
+        """
+        need_slot = self.scheduler.free_slots == 0
+        need_pages = 0
+        if self.paged:
+            need_pages = self._pages_for(request) - self._pool.free_pages
+        if not need_slot and need_pages <= 0:
+            return []  # the head is not resource-blocked: nothing to free
+        candidates = [
+            (slot, r) for slot, r in self.scheduler.occupied()
+            if r.priority < request.priority and r.preemptions < self.max_preemptions
+        ]
+        candidates.sort(key=lambda sr: (
+            sr[1].priority,
+            -(len(self._slot_pages[sr[0]]) if self.paged and self._slot_pages[sr[0]] else 0),
+            -sr[1].request_id,
+        ))
+        chosen, freed_pages, freed_slots = [], 0, 0
+        for slot, r in candidates:
+            if freed_pages >= need_pages and freed_slots >= (1 if need_slot else 0):
+                break
+            chosen.append((slot, r))
+            if self.paged and self._slot_pages[slot]:
+                freed_pages += len(self._slot_pages[slot])
+            freed_slots += 1
+        if freed_pages < need_pages or (need_slot and freed_slots < 1):
+            return []
+        # minimization pass: the cross-class greedy can pick a cheap
+        # low-class victim that a later, larger victim then makes redundant
+        # (class-0 holding 2 pages chosen before the class-1 holding 10 that
+        # covers the need alone) — evicting it would burn its preemption
+        # budget and a full replay for zero admission benefit. Drop, in the
+        # same deterministic selection order, every victim whose contribution
+        # is no longer needed for coverage.
+        for slot, r in list(chosen):
+            pages = (len(self._slot_pages[slot])
+                     if self.paged and self._slot_pages[slot] else 0)
+            if (freed_pages - pages >= need_pages
+                    and (not need_slot or freed_slots - 1 >= 1)):
+                chosen.remove((slot, r))
+                freed_pages -= pages
+                freed_slots -= 1
+        return chosen
+
+    def _preempt(self, slot: int, request: ServedRequest, preemptor: ServedRequest) -> None:
+        """Evict one victim UNDER PRIORITY PRESSURE: device-side this is
+        exactly the normal eviction (release program + pages back to the
+        pool — zero new compiled programs), host-side the handle stays LIVE:
+        it re-queues at its original priority and seniority as a prompt +
+        emitted-tokens replay, so the resumed decode trajectory — rng chain
+        included — is f64 token-identical to an uncontended run (the router
+        failover mechanism, reused intra-engine)."""
+        self.scheduler.release(slot)
+        self._replay_slots.pop(slot, None)
+        self._state = self._jit_release(self._state, slot)
+        pages_freed = 0
+        if self.paged:
+            self._cache = self._jit_release_pages(self._cache, slot)
+            pages = self._slot_pages[slot]
+            if pages:
+                pages_freed = len(pages)
+                self._pool.release(pages)
+            self._slot_pages[slot] = None
+            self.metrics.set_page_pool(
+                self._pool.num_pages - self._pool.reserved, self._pool.pages_in_use
+            )
+        # the replay stream is the LONGEST known token prefix: normally the
+        # emitted tokens, but a victim preempted mid-replay (failover replay,
+        # or a second preemption) still owes the tail of its previous stream
+        # — truncating to output_ids would silently drop it
+        if request.replay_ids is not None and request.replay_ids.size > len(request.output_ids):
+            stream = request.replay_ids
+        elif request.output_ids:
+            stream = np.asarray(request.output_ids, np.int32)
+        else:
+            stream = None
+        request.replay_ids = stream
+        request.replay_pos = 0
+        request.status = RequestStatus.PREEMPTED
+        request.slot = None
+        request.pages_allocated = None
+        request.preemptions += 1
+        request.enqueued_at = time.perf_counter()
+        self.scheduler.enqueue(request, priority=request.priority,
+                               seq=request.request_id)
+        self.metrics.record_preempt(
+            request.request_id, slot, preempted_by=preemptor.request_id,
+            pages_freed=pages_freed, emitted_tokens=len(request.output_ids),
+            priority=request.priority,
+        )
+        if self._obs_on:
+            self._obs.counter_inc(f"{self._obs_ns}.preemptions")
+            self._obs.async_instant(self._span_cat, request.request_id,
+                                    "preempted", by=preemptor.request_id,
+                                    emitted=len(request.output_ids))
+
+    def _preempt_for_blocked_head(self, can_admit) -> None:
+        """Admission's second pass: while the admission-order head is blocked
+        on pages/slots and a set of strictly-lower-class victims can free
+        enough, preempt them and re-run admission so the head admits THIS
+        tick. Bounded by the slot count per tick (each pass admits at least
+        one request or stops)."""
+        for _ in range(self.num_slots):
+            head = self.scheduler.peek()
+            if head is None:
+                return
+            victims = self._select_victims(head)
+            if not victims:
+                return
+            for slot, victim in victims:
+                self._preempt(slot, victim, preemptor=head)
+            admitted = False
+            for slot, request in self.scheduler.pop_admissible(can_admit):
+                self._admit(slot, request)
+                admitted = True
+            if not admitted:
+                return  # defensive: the gate disagreed with the selection
 
     # --------------------------------------------------------------- deadlines
     def _expire_deadlines(self, now: float) -> None:
@@ -993,11 +1194,15 @@ class ServingEngine:
             request.finish_reason = "deadline"
             request.finished_at = now
             self.finished.append(request)
-            self.metrics.record_timeout_queued(request.request_id)
+            # a PREEMPTED continuation expiring in the queue DID hold a slot:
+            # its emitted tokens ride the terminal event (0 for the
+            # never-admitted case), keeping the stream's accounting honest
+            self.metrics.record_timeout_queued(request.request_id,
+                                               new_tokens=len(request.output_ids))
             if self._obs_on:
                 self._obs.async_end(self._span_cat, request.request_id,
                                     status="timed_out", reason="deadline",
-                                    new_tokens=0)
+                                    new_tokens=len(request.output_ids))
         for slot, request in list(self.scheduler.occupied()):
             if request.deadline_at is not None and now >= request.deadline_at:
                 self._evict(slot, request, "deadline", status=RequestStatus.TIMED_OUT)
@@ -1046,13 +1251,25 @@ class ServingEngine:
         # would sit in the recorder's open-span stack forever).
         self._obs.span_begin(self._span_tick)
         try:
+            self.scheduler.advance_tick()  # the priority-aging clock (int add)
             if self._deadlines_seen:
                 self._expire_deadlines(time.perf_counter())
-            if not self._draining:
+            if not self._draining or self.scheduler.queue_depth:
+                # while draining, the queue can only hold PREEMPTED
+                # continuations (fresh submits are refused and _begin_drain
+                # rejected the never-admitted backlog): they are accepted
+                # mid-generation work, so they re-admit as capacity frees and
+                # FINISH — drain's "in-flight work is finished, not dropped"
+                # contract covers a victim parked by preemption
                 with self._obs.span(self._span_admit):
                     can_admit = self._can_admit_paged if self.paged else None
                     for slot, request in self.scheduler.pop_admissible(can_admit):
                         self._admit(slot, request)
+                    if self.priority_preemption and not self._draining:
+                        # second pass: a higher-class head blocked on
+                        # pages/slots may evict strictly-lower-class running
+                        # work and admit this tick (docs/serving.md)
+                        self._preempt_for_blocked_head(can_admit)
             self._maybe_inject_nan()
             occupied = list(self.scheduler.occupied())
             if self._obs_on:
@@ -1147,13 +1364,21 @@ class ServingEngine:
                                 status=RequestStatus.FAILED)
                     continue
                 token = int(tok[slot])
-                request.output_ids.append(token)
                 if slot in self._replay_slots:
                     # one replayed token landed; free-running resumes when
-                    # the forced stream is exhausted
+                    # the forced stream is exhausted. A fresh failover handle
+                    # re-emits the replayed prefix into output_ids; a
+                    # PREEMPTED handle already holds it (the stream must stay
+                    # monotonic for streaming consumers), so append only past
+                    # what the handle has — the replayed token is identical
+                    # by construction either way
+                    if len(request.output_ids) <= request.replay_pos:
+                        request.output_ids.append(token)
                     request.replay_pos += 1
                     if request.replay_pos >= request.replay_ids.size:
                         del self._replay_slots[slot]
+                else:
+                    request.output_ids.append(token)
                 cfg = request.config
                 if cfg.eos_token_id is not None and token == cfg.eos_token_id:
                     self._evict(slot, request, "eos")
@@ -1214,9 +1439,16 @@ class ServingEngine:
 
     def _begin_drain(self) -> None:
         """Close admission and reject the queued backlog (shared by explicit
-        ``drain()`` and the SIGTERM/SIGINT graceful path)."""
+        ``drain()`` and the SIGTERM/SIGINT graceful path). PREEMPTED
+        continuations are NOT backlog — they are mid-generation work a
+        higher class displaced, with tokens possibly already streamed to a
+        client — so they stay queued and finish through the drain loop the
+        way running slots do (REJECTED is documented as "never reached a
+        slot", which would misreport them)."""
         self._draining = True
-        for request in self.scheduler.prune_queue(lambda r: True):
+        for request in self.scheduler.prune_queue(
+            lambda r: r.status is not RequestStatus.PREEMPTED
+        ):
             self._reject(request, "draining")
 
     def drain(self, max_steps: Optional[int] = None) -> List[ServedRequest]:

@@ -53,6 +53,18 @@ Schema history:
     ``alloc_failure`` events. Router snapshots report ``page_pool: None``
     (pools are per-engine; the embedded replica sections carry the real
     gauges). The reader normalizes pre-v5 snapshots with ``None``.
+  * ``serving-metrics/v6`` — the priority/preemption schema (docs/serving.md,
+    "Priority classes & preemption"): snapshots gain ``preemptions`` (running
+    slots evicted under priority pressure), ``preempted_replays`` (preempted
+    continuations re-admitted as forced replays), and
+    ``queue_wait_by_priority`` (per-priority-class submit→admit p50/p95 over
+    the latency window; ``None`` on router snapshots — queue waits are
+    measured per engine, the replica sections carry the real stats). The
+    stream gains ``preempt`` events, ``submit`` events carry ``priority``,
+    and ``admit`` events carry ``priority`` (+ ``preempted_replay: true`` on
+    a resume). Router snapshots aggregate ``preemptions`` /
+    ``preempted_replays`` over their replica sections. The reader normalizes
+    pre-v6 snapshots with ``None`` — the v2→v3 discipline throughout.
 """
 
 from __future__ import annotations
@@ -65,16 +77,20 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v5"
+SCHEMA = "serving-metrics/v6"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
     "serving-metrics/v3",
     "serving-metrics/v4",
     "serving-metrics/v5",
+    "serving-metrics/v6",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
+_V6_FIELDS = ("preemptions", "preempted_replays", "queue_wait_by_priority")
+_PRE_V5 = KNOWN_SCHEMAS[:4]
+_PRE_V6 = KNOWN_SCHEMAS[:5]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -145,10 +161,15 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # not run zero of them)
                 for k in _V4_FIELDS:
                     snap.setdefault(k, None)
-            if schema != "serving-metrics/v5":
-                # pre-v5 writers had no page pool; None also matches a v5
-                # DENSE engine's truthful "no pool exists"
+            if schema in _PRE_V5:
+                # pre-v5 writers had no page pool; None also matches a
+                # newer DENSE engine's truthful "no pool exists"
                 snap.setdefault("page_pool", None)
+            if schema in _PRE_V6:
+                # pre-v6 writers had no priority/preemption counters: None,
+                # not 0 — "not recorded" stays distinguishable from "none"
+                for k in _V6_FIELDS:
+                    snap.setdefault(k, None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -236,9 +257,13 @@ class EngineMetrics(_JsonlMetrics):
     pages_total: Optional[int] = None
     pages_in_use: int = 0
     alloc_failures: int = 0  # head-of-line blocking episodes on the free list
+    # priority/preemption counters (serving-metrics/v6, docs/serving.md)
+    preemptions: int = 0  # running slots evicted under priority pressure
+    preempted_replays: int = 0  # preempted continuations re-admitted (replay)
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
     _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _queue_waits_by_priority: Dict[int, Deque] = field(default_factory=dict)
     _queue_waits: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _prefill_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _decode_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -246,29 +271,54 @@ class EngineMetrics(_JsonlMetrics):
     _closed: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------------ events
-    def record_submit(self, request_id: int, prompt_len: int) -> None:
+    def record_submit(self, request_id: int, prompt_len: int,
+                      priority: int = 0) -> None:
         if self._start_time is None:
             self._start_time = time.perf_counter()
         self.requests_submitted += 1
         self.queue_depth += 1
-        self._emit("submit", request_id=request_id, prompt_len=prompt_len)
+        self._emit("submit", request_id=request_id, prompt_len=prompt_len,
+                   priority=priority)
 
     def record_admit(
         self, request_id: int, slot: int, wait_s: float, prefill_s: float,
         bucket: Optional[int] = None, pages: Optional[int] = None,
+        priority: int = 0, preempted_replay: bool = False,
     ) -> None:
         self.requests_admitted += 1
         self.prefills += 1
         self.prefill_seconds += prefill_s
         self.queue_depth = max(self.queue_depth - 1, 0)
         self._queue_waits.append(wait_s)
+        # per-priority-class queue-wait window (serving-metrics/v6): the
+        # per-class p50/p95 is what the preemption bench's SLO story ranks on
+        self._queue_waits_by_priority.setdefault(
+            int(priority), deque(maxlen=LATENCY_WINDOW)
+        ).append(wait_s)
         self._prefill_times.append(prefill_s)
         extra = {} if bucket is None else {"bucket": bucket}
         if pages is not None:  # paged engines: the request's page reservation
             self._pages_per_request.append(pages)
             extra["pages"] = pages
+        if preempted_replay:  # a preempted continuation re-admitted as replay
+            self.preempted_replays += 1
+            extra["preempted_replay"] = True
         self._emit("admit", request_id=request_id, slot=slot,
-                   wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6), **extra)
+                   wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6),
+                   priority=priority, **extra)
+
+    def record_preempt(self, request_id: int, slot: int, preempted_by: int,
+                       pages_freed: int, emitted_tokens: int,
+                       priority: int) -> None:
+        """One priority preemption: a running slot evicted so a higher-class
+        blocked request can admit; the victim re-enters the queue (the
+        ``queue_depth`` gauge moves back up) and will re-admit as a forced
+        replay (``preempted_replay`` on its next ``admit`` event)."""
+        self.preemptions += 1
+        self.queue_depth += 1
+        self._emit("preempt", request_id=request_id, slot=slot,
+                   preempted_by=preempted_by, pages_freed=pages_freed,
+                   emitted_tokens=emitted_tokens, priority=priority)
 
     def record_alloc_failure(self, request_id: int, pages_needed: int, pages_free: int) -> None:
         """One head-of-line BLOCKING EPISODE: the head request's page
@@ -314,19 +364,28 @@ class EngineMetrics(_JsonlMetrics):
         self.queue_depth = max(self.queue_depth - 1, 0)
         self._emit("reject", request_id=request_id, reason=reason)
 
-    def record_timeout_queued(self, request_id: int, reason: str = "deadline") -> None:
-        """Terminal event for a QUEUED request whose deadline expired before
-        it ever reached a slot."""
-        self.record_evict_queued(request_id, reason, status="timed_out")
+    def record_timeout_queued(self, request_id: int, reason: str = "deadline",
+                              new_tokens: int = 0) -> None:
+        """Terminal event for a QUEUED request whose deadline expired while
+        waiting. ``new_tokens`` is nonzero for a PREEMPTED continuation that
+        held a slot before parking — its decode work must not vanish from
+        the event stream."""
+        self.record_evict_queued(request_id, reason, status="timed_out",
+                                 new_tokens=new_tokens)
 
-    def record_evict_queued(self, request_id: int, reason: str, status: str) -> None:
-        """Terminal event for a QUEUED request evicted before reaching a slot
-        (deadline expiry, cancellation, failover reclaim). ``status`` routes
-        the counter exactly as ``record_finish`` does for slot-holders."""
+    def record_evict_queued(self, request_id: int, reason: str, status: str,
+                            new_tokens: int = 0) -> None:
+        """Terminal event for a QUEUED request evicted before (re)reaching a
+        slot (deadline expiry, cancellation, failover reclaim). ``status``
+        routes the counter exactly as ``record_finish`` does for
+        slot-holders; ``new_tokens`` carries the tokens a preempted
+        continuation emitted before it was parked (0 for never-admitted
+        requests), so the terminal event agrees with the handle and with the
+        ``preempt`` event's ``emitted_tokens``."""
         self._route_status(status)
         self.queue_depth = max(self.queue_depth - 1, 0)
-        self._emit("finish", request_id=request_id, slot=None, new_tokens=0,
-                   reason=reason, status=status)
+        self._emit("finish", request_id=request_id, slot=None,
+                   new_tokens=new_tokens, reason=reason, status=status)
 
     # ---------------------------------------------------------------- snapshot
     def latency_estimates(self) -> Optional[Dict[str, float]]:
@@ -379,6 +438,15 @@ class EngineMetrics(_JsonlMetrics):
             "queue_wait_s": _latency_dict(self._queue_waits),
             "prefill_s": _latency_dict(self._prefill_times),
             "decode_step_s": _latency_dict(self._decode_times),
+            # v6 (docs/serving.md, priority section): preemption counters +
+            # per-class queue-wait percentiles over the latency window
+            "preemptions": self.preemptions,
+            "preempted_replays": self.preempted_replays,
+            "queue_wait_by_priority": {
+                str(p): {k: v for k, v in _latency_dict(xs).items()
+                         if k in _PERCENTILE_KEYS}
+                for p, xs in sorted(self._queue_waits_by_priority.items())
+            },
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -428,11 +496,13 @@ class RouterMetrics(_JsonlMetrics):
     _closed: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------------ events
-    def record_submit(self, request_id: int, prompt_len: int) -> None:
+    def record_submit(self, request_id: int, prompt_len: int,
+                      priority: int = 0) -> None:
         if self._start_time is None:
             self._start_time = time.perf_counter()
         self.requests_submitted += 1
-        self._emit("submit", request_id=request_id, prompt_len=prompt_len)
+        self._emit("submit", request_id=request_id, prompt_len=prompt_len,
+                   priority=priority)
 
     def record_dispatch(self, request_id: int, replica: int, load: int) -> None:
         """One accepted hand-off to a replica's engine (initial dispatch or a
@@ -491,6 +561,15 @@ class RouterMetrics(_JsonlMetrics):
             "failovers": self.failovers,
             "shed_infeasible": self.shed_infeasible,
             "breaker_transitions": dict(sorted(self.breaker_transitions.items())),
+            # v6: preemptions happen inside engines — the router aggregates
+            # its replica sections (0 with no replicas handed in); queue
+            # waits are measured per engine, so the per-class stats live in
+            # the replica sections (None here, the page_pool discipline)
+            "preemptions": sum(s.get("preemptions") or 0 for s in replicas.values()),
+            "preempted_replays": sum(
+                s.get("preempted_replays") or 0 for s in replicas.values()
+            ),
+            "queue_wait_by_priority": None,
             # pools are per-engine: the embedded replica sections carry the
             # real gauges, the router itself truthfully has none
             "page_pool": None,

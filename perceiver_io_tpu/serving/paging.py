@@ -44,6 +44,7 @@ f64 greedy parity pinned both ways (tests/test_paging.py).
 from __future__ import annotations
 
 import os
+from collections import Counter
 from heapq import heapify, heappop, heappush
 from typing import List, Sequence
 
@@ -121,22 +122,38 @@ class PagePool:
         self.total_allocations += n
         return pages
 
+    def _validate_ids(self, pages: Sequence[int]) -> None:
+        bad = [p for p in pages if not 0 <= p < self.num_pages]
+        if bad:
+            raise ValueError(f"page id(s) {bad} outside pool of {self.num_pages}")
+
     def retain(self, pages: Sequence[int]) -> None:
         """Add one reference to each page — the prefix-sharing primitive
         (ROADMAP item 3: forking a shared prompt retains its pages and copies
-        the page table)."""
+        the page table). Validates the WHOLE list before touching any
+        refcount: an invalid id mid-list must leave the pool exactly as it
+        was (validate-then-mutate; a partial retain would leak references on
+        the raise path)."""
+        self._validate_ids(pages)
         for p in pages:
             if self._refcount[p] < 1:
                 raise ValueError(f"page {p} is not allocated")
+        for p in pages:
             self._refcount[p] += 1
 
     def release(self, pages: Sequence[int]) -> None:
         """Drop one reference per page; pages reaching refcount 0 return to
         the free list. Double-free raises (a slot's page list is consumed
-        exactly once, at eviction)."""
-        for p in pages:
-            if self._refcount[p] < 1:
+        exactly once, at eviction) — and raises BEFORE any refcount moves:
+        validation covers the whole list first (duplicate ids counted against
+        the refcount, so ``release([p, p])`` of a once-held page is caught),
+        so a double-free mid-list leaves the pool state untouched instead of
+        half-released and inconsistent."""
+        self._validate_ids(pages)
+        for p, n in Counter(pages).items():
+            if self._refcount[p] < n:
                 raise ValueError(f"double free of page {p}")
+        for p in pages:
             self._refcount[p] -= 1
             if self._refcount[p] == 0:
                 heappush(self._free, p)

@@ -70,9 +70,11 @@ Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v5``:
-router snapshots embed per-replica engine snapshots and the
-failover/shed/breaker counters.
+phase tables from the single trace. Metrics are ``serving-metrics/v6``:
+router snapshots embed per-replica engine snapshots, the
+failover/shed/breaker counters, and the aggregated preemption counters
+(request ``priority`` is forwarded to engines; engine-local preemption under
+page-pool pressure is docs/serving.md's "Priority classes & preemption").
 """
 
 from __future__ import annotations
@@ -124,6 +126,10 @@ class RoutedRequest:
     prompt_ids: np.ndarray
     config: GenerationConfig
     rng: object
+    # priority class, forwarded verbatim to whichever engine serves the
+    # request — failover re-dispatch keeps it, so a continuation competes at
+    # its original class on the new replica (docs/serving.md)
+    priority: int = 0
     finish_reason: Optional[str] = None
     submitted_at: float = 0.0
     finished_at: Optional[float] = None
@@ -148,7 +154,8 @@ class RoutedRequest:
             return self._terminal_status
         handle = self._engine_handle
         if handle is not None:
-            if handle.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
+            if handle.status in (RequestStatus.QUEUED, RequestStatus.RUNNING,
+                                 RequestStatus.PREEMPTED):
                 return handle.status
             return RequestStatus.RUNNING
         return RequestStatus.QUEUED
@@ -243,6 +250,8 @@ class ServingRouter:
         default_deadline_s: Optional[float] = None,
         kv_page_size: Optional[int] = None,
         num_kv_pages: Optional[int] = None,
+        priority_aging_ticks: Optional[int] = None,
+        max_preemptions: int = 2,
         telemetry=None,
         handle_preemption: bool = False,
         # failover / breaker policy (docs/reliability.md failure-domain table)
@@ -307,6 +316,11 @@ class ServingRouter:
                     # exactly the victim's page count (pinned, test_router)
                     kv_page_size=kv_page_size,
                     num_kv_pages=num_kv_pages,
+                    # priority/preemption policy is per-engine (each replica
+                    # preempts over its own slots and pool); the router only
+                    # forwards classes and reads the aggregated counters
+                    priority_aging_ticks=priority_aging_ticks,
+                    max_preemptions=max_preemptions,
                     # per-replica engine event stream: a "{i}" placeholder in
                     # the template keeps the streams separate per replica
                     metrics_jsonl=replica_metrics_jsonl.format(i=i)
@@ -344,13 +358,17 @@ class ServingRouter:
         config: Optional[GenerationConfig] = None,
         rng=None,
         deadline_s: Optional[float] = None,
+        priority: int = 0,
         **kwargs,
     ) -> RoutedRequest:
         """Queue one request; returns its router-level handle. Semantics
         mirror ``ServingEngine.submit``: malformed requests raise, well-formed
         requests the fleet cannot serve come back terminal in REJECTED —
         including the router-only outcome ``shed_infeasible`` (the deadline
-        cannot be met per the live latency estimates)."""
+        cannot be met per the live latency estimates). ``priority`` is
+        forwarded verbatim to the serving engine (higher wins; a class-k head
+        blocked on pages/slots preempts strictly-lower-class running work
+        there — docs/serving.md, "Priority classes & preemption")."""
         if config is None:
             config = GenerationConfig(**kwargs)
         elif kwargs:
@@ -369,12 +387,14 @@ class ServingRouter:
             prompt_ids=prompt,
             config=config,
             rng=rng,
+            priority=int(priority),
             submitted_at=time.perf_counter(),
             deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
         )
         if routed.deadline_s is not None:
             self._deadlines_seen = True
-        self.metrics.record_submit(routed.request_id, int(prompt.size))
+        self.metrics.record_submit(routed.request_id, int(prompt.size),
+                                   priority=routed.priority)
         if self._obs_on:
             self._obs.async_begin("router.request", routed.request_id,
                                   prompt_len=int(prompt.size))
@@ -449,6 +469,7 @@ class ServingRouter:
                 routed.prompt_ids, config=routed.config, rng=routed.rng,
                 deadline_s=self._remaining_deadline(routed, now),
                 replay_ids=emitted if emitted else None,
+                priority=routed.priority,
             )
             if handle.status is RequestStatus.REJECTED:
                 if handle.finish_reason == "queue_full":
@@ -837,7 +858,7 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v5 router snapshot with per-replica sections."""
+        """serving-metrics/v6 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:

@@ -1,11 +1,28 @@
-"""Slot scheduler: FIFO admission of queued requests into free decode slots.
+"""Slot scheduler: priority-class admission of queued requests into free
+decode slots.
 
 The scheduler is pure host-side bookkeeping — it never touches jax. The
 engine owns the device state (batched cache + slot state pytree); the
 scheduler decides WHICH request occupies WHICH batch row and when. Keeping
-the policy isolated here means alternative policies (priority classes,
-shortest-prompt-first, deadline-aware eviction) can be dropped in without
-touching the compiled decode path.
+the policy isolated here means alternative policies (shortest-prompt-first,
+deadline-aware eviction) can be dropped in without touching the compiled
+decode path.
+
+Admission order (docs/serving.md, "Priority classes & preemption"):
+
+  * every queued entry carries a small-int **priority class** (default 0,
+    higher wins) and a monotone **sequence number** (the engine passes its
+    request id, so a preempted request re-queued mid-flight keeps its
+    original seniority);
+  * order is (effective priority descending, sequence ascending) — strict
+    FIFO within a class, deterministic across classes;
+  * **aging** (anti-starvation): with ``aging_ticks=N``, a queued entry's
+    effective priority rises by one class every N scheduler ticks it has
+    waited — tick-counted like the router's breaker cooldowns, no clocks, no
+    randomness, so the order is a pure function of the submit/tick history.
+    Aging affects queue ORDER only; preemption eligibility (serving/engine.py)
+    always compares base priorities, so an aged class-0 request can outwait
+    higher classes but never evict them.
 
 Design constraints inherited from the device side (docs/serving.md):
   * the slot count is static — it is the batch dimension of the compiled
@@ -20,22 +37,55 @@ Design constraints inherited from the device side (docs/serving.md):
 
 from __future__ import annotations
 
+import itertools
+import os
 from collections import deque
 from typing import Callable, Deque, Generic, Iterator, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
 
 
-class SlotScheduler(Generic[T]):
-    """FIFO queue + free-list over a fixed pool of ``num_slots`` decode slots."""
+def preemption_enabled() -> bool:
+    """Kill-switch for the priority/preemption feature:
+    ``PERCEIVER_IO_TPU_DISABLE_PREEMPTION=1`` pins engines to the pre-PR
+    behavior — the queue is strict submit-order FIFO (priorities ignored, no
+    aging) and running slots are never preempted, so pool pressure surfaces
+    exclusively as the old ``queue_full`` backpressure. Checked at engine
+    construction, like the paged-KV switch; f64 parity when off is pinned by
+    the ``preempt_disabled_inert`` chaos scenario."""
+    return os.environ.get("PERCEIVER_IO_TPU_DISABLE_PREEMPTION", "0").lower() in ("0", "false", "")
 
-    def __init__(self, num_slots: int):
+
+class _Entry:
+    """One queued request with its ordering metadata."""
+
+    __slots__ = ("request", "priority", "seq", "tick")
+
+    def __init__(self, request, priority: int, seq: int, tick: int):
+        self.request = request
+        self.priority = priority
+        self.seq = seq
+        self.tick = tick
+
+
+class SlotScheduler(Generic[T]):
+    """Priority queue + free-list over a fixed pool of ``num_slots`` decode
+    slots. With default priorities and no aging this degenerates to the
+    original FIFO (the pre-priority behavior, bit-identical — pinned by the
+    ``preempt_disabled_inert`` chaos scenario)."""
+
+    def __init__(self, num_slots: int, aging_ticks: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if aging_ticks is not None and aging_ticks < 1:
+            raise ValueError(f"aging_ticks must be >= 1, got {aging_ticks}")
         self.num_slots = num_slots
-        self._queue: Deque[T] = deque()
+        self.aging_ticks = aging_ticks
+        self.ticks = 0  # the aging clock: advanced once per engine tick
+        self._queue: List[_Entry] = []
         self._slots: List[Optional[T]] = [None] * num_slots
         self._free: Deque[int] = deque(range(num_slots))
+        self._seq = itertools.count()  # fallback when the caller passes no seq
         self.total_admissions = 0
 
     # ------------------------------------------------------------------- state
@@ -60,7 +110,9 @@ class SlotScheduler(Generic[T]):
         """Backlog beyond free capacity: ``queue_depth - free_slots``. Negative
         = idle headroom. The engine's queue bound and the router's
         least-loaded dispatch (serving/router.py) both rank on this number, so
-        "how full is this pool" has exactly one definition."""
+        "how full is this pool" has exactly one definition. Preempted
+        continuations parked back in the queue count like any other entry —
+        the router's dispatch sees preempted-replay parking as real load."""
         return len(self._queue) - len(self._free)
 
     def occupant(self, slot: int) -> Optional[T]:
@@ -73,49 +125,90 @@ class SlotScheduler(Generic[T]):
                 yield slot, req
 
     def queued(self) -> Iterator[T]:
-        """Queued requests in FIFO order (read-only view) — the engine's
+        """Queued requests in ADMISSION order (read-only view) — the engine's
         paged capacity estimate walks this to simulate head-of-line
         admissions against the free page count (serving/engine.py)."""
-        return iter(self._queue)
+        return (e.request for e in self._ordered())
 
     # ------------------------------------------------------------------ policy
-    def enqueue(self, request: T) -> None:
-        self._queue.append(request)
+    def advance_tick(self) -> None:
+        """Advance the aging clock (one call per engine tick). A no-op cost
+        when aging is off; with ``aging_ticks=N`` every queued entry's
+        effective priority rises by one class per N ticks waited."""
+        self.ticks += 1
+
+    def effective_priority(self, entry: _Entry) -> int:
+        if self.aging_ticks is None:
+            return entry.priority
+        return entry.priority + (self.ticks - entry.tick) // self.aging_ticks
+
+    def _order_key(self, entry: _Entry):
+        # higher effective class first; FIFO (sequence) within a class
+        return (-self.effective_priority(entry), entry.seq)
+
+    def _ordered(self) -> List[_Entry]:
+        return sorted(self._queue, key=self._order_key)
+
+    def enqueue(self, request: T, priority: int = 0, seq: Optional[int] = None) -> None:
+        """Queue one request at ``priority`` (higher wins). ``seq`` is the
+        FIFO tiebreaker within a class — the engine passes its monotone
+        request id so a preempted request re-queued mid-flight resumes its
+        ORIGINAL seniority instead of going to the back; callers that pass
+        nothing get an internal counter (plain FIFO)."""
+        self._queue.append(_Entry(
+            request, priority, next(self._seq) if seq is None else seq, self.ticks
+        ))
+
+    def peek(self) -> Optional[T]:
+        """The request ``pop_admissible`` would admit next (admission-order
+        head), or None — the engine's preemption trigger inspects it without
+        claiming a slot."""
+        if not self._queue:
+            return None
+        return min(self._queue, key=self._order_key).request
 
     def prune_queue(self, predicate: Callable[[T], bool]) -> List[T]:
-        """Remove and return every QUEUED request matching ``predicate``,
-        preserving FIFO order among survivors — the admission-control
-        primitive behind deadline expiry of waiting requests and the
-        reject-the-backlog step of a graceful drain (serving/engine.py).
-        Requests already occupying slots are untouched (evicting a running
-        request is the engine's job: it owns the device state)."""
-        kept: Deque[T] = deque()
+        """Remove and return every QUEUED request matching ``predicate``
+        (insertion order), preserving the remaining entries' priorities and
+        seniority — the admission-control primitive behind deadline expiry of
+        waiting requests and the reject-the-backlog step of a graceful drain
+        (serving/engine.py). Requests already occupying slots are untouched
+        (evicting a running request is the engine's job: it owns the device
+        state)."""
+        kept: List[_Entry] = []
         removed: List[T] = []
-        for request in self._queue:
-            (removed if predicate(request) else kept).append(request)
-        if removed:  # nothing matched: keep the original deque untouched
+        for entry in self._queue:
+            if predicate(entry.request):
+                removed.append(entry.request)
+            else:
+                kept.append(entry)
+        if removed:  # nothing matched: keep the original list untouched
             self._queue = kept
         return removed
 
     def pop_admissible(self, can_admit: Optional[Callable[[T], bool]] = None) -> Iterator[Tuple[int, T]]:
-        """Yield (slot, request) admissions until slots or queue run out.
-        The slot is claimed as soon as the pair is yielded, so the engine can
-        interleave prefill/install work with further admissions.
+        """Yield (slot, request) admissions in admission order until slots or
+        queue run out. The slot is claimed as soon as the pair is yielded, so
+        the engine can interleave prefill/install work with further
+        admissions.
 
         ``can_admit`` adds a per-request resource gate (the paged engine's
-        free-page check): when the HEAD request fails it, admission stops —
-        head-of-line blocking on purpose, because skipping ahead would break
-        FIFO fairness and make page-allocation order depend on queue
+        free-page check): when the HEAD request (highest effective priority,
+        FIFO within its class) fails it, admission stops — head-of-line
+        blocking on purpose, because skipping ahead would break the priority
+        order's fairness and make page-allocation order depend on queue
         composition rather than history (determinism contract,
-        serving/paging.py)."""
+        serving/paging.py). A head blocked on resources is the engine's cue
+        to preempt (serving/engine.py)."""
         while self._queue and self._free:
-            if can_admit is not None and not can_admit(self._queue[0]):
+            head = min(self._queue, key=self._order_key)
+            if can_admit is not None and not can_admit(head.request):
                 return
             slot = self._free.popleft()
-            request = self._queue.popleft()
-            self._slots[slot] = request
+            self._queue.remove(head)
+            self._slots[slot] = head.request
             self.total_admissions += 1
-            yield slot, request
+            yield slot, head.request
 
     def release(self, slot: int) -> T:
         """Free a slot (request finished or cancelled); returns the occupant.

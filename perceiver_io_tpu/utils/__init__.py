@@ -1,0 +1,27 @@
+"""Small stdlib-only helpers shared across scripts and the package."""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+
+@contextmanager
+def env_override(key: str, value: Optional[str]) -> Iterator[None]:
+    """Temporarily set (or, with ``value=None``, unset) one env var,
+    restoring the previous state — including previously-unset — on exit.
+    The kill-switch benches and chaos scenarios use this to build engines
+    under a specific switch without leaking it into later arms."""
+    prev = os.environ.get(key)
+    try:
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev

@@ -33,6 +33,16 @@ exercised on every change, not just when production finds them:
                            head-block then shed deterministically as
                            queue_full (no crash, no request lost); survivors
                            are f64 token-identical to an uncontended run
+  * ``preempt_storm``      low-priority long sessions saturate a small page
+                           pool; a high-priority deadline request admits via
+                           PREEMPTION the very next tick; victims resume and
+                           finish f64-identical to an uncontended run;
+                           repeat runs pin identical statuses, tokens, AND
+                           victim identity; no request lost
+  * ``preempt_disabled_inert`` PERCEIVER_IO_TPU_DISABLE_PREEMPTION=1 makes
+                           the same priority-bearing workload bit-identical
+                           to the pre-priority FIFO engine (plain queue_full
+                           backpressure, zero preemptions)
 
 Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
 
@@ -417,6 +427,124 @@ def check_paging_pool_exhaustion() -> dict:
     }
 
 
+def check_preempt_storm() -> dict:
+    """Priority pressure on a saturated page pool (docs/serving.md "Priority
+    classes & preemption"): low-priority long sessions hold every page; a
+    high-priority deadline-bearing request admits via PREEMPTION on its first
+    tick instead of waiting out a whole session; the victim resumes as a
+    forced replay and finishes f64 token-identical to an uncontended run;
+    repeat runs pin statuses, tokens, and exact victim identity; every
+    request reaches a terminal status."""
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8]]  # bg, bg, hi
+
+        # uncontended reference: same page geometry, default (ample) pool
+        ref_engine = _engine(model, params, num_slots=3, kv_page_size=2)
+        ref_handles = [ref_engine.submit(p, max_new_tokens=4) for p in prompts]
+        ref_engine.run_until_drained(max_steps=300)
+        ref_tokens = [h.result().tolist() for h in ref_handles]
+
+        def run():
+            # page 2: each (bucket 6 + 4 new) reservation is 5 pages; 10
+            # allocatable pages -> the two background sessions hold them ALL
+            engine = _engine(model, params, num_slots=3, kv_page_size=2,
+                             num_kv_pages=11)
+            bg = [engine.submit(p, max_new_tokens=4) for p in prompts[:2]]
+            engine.step()  # both admitted, pool saturated
+            hi = engine.submit(prompts[2], max_new_tokens=4, priority=2,
+                               deadline_s=60.0)
+            engine.step()  # page-blocked -> preempts one victim, admits NOW
+            admitted_first_tick = hi.status.value == "running"
+            victims = [h.request_id for h in bg if h.preemptions > 0]
+            engine.run_until_drained(max_steps=400)
+            snap = engine.metrics.snapshot()
+            handles = bg + [hi]
+            return {
+                "statuses": [h.status.value for h in handles],
+                "tokens": [h.result().tolist() for h in handles],
+                "victims": victims,
+                "admitted_first_tick": admitted_first_tick,
+                "snap": snap,
+            }
+
+        r1, r2 = run(), run()
+    snap = r1["snap"]
+    accounted = (
+        snap["requests_submitted"]
+        == snap["requests_finished"] + snap["rejected"] + snap["timed_out"] + snap["failed"]
+    )
+    repeat_identical = (
+        (r1["statuses"], r1["tokens"], r1["victims"])
+        == (r2["statuses"], r2["tokens"], r2["victims"])
+    )
+    return {
+        "ok": (
+            r1["admitted_first_tick"]
+            and r1["statuses"] == ["finished"] * 3
+            and r1["tokens"] == ref_tokens
+            and len(r1["victims"]) == 1
+            and snap["preemptions"] == 1
+            and snap["preempted_replays"] == 1
+            and repeat_identical
+            and accounted
+            and snap["page_pool"]["pages_in_use"] == 0
+        ),
+        "statuses": r1["statuses"],
+        "hi_admitted_via_preemption_first_tick": r1["admitted_first_tick"],
+        "victims_resumed_identical": r1["tokens"] == ref_tokens,
+        "deterministic_repeat": repeat_identical,
+        "victim_ids": r1["victims"],
+        "no_request_lost": accounted,
+    }
+
+
+def check_preempt_disabled_inert() -> dict:
+    """Kill-switch inertness: with PERCEIVER_IO_TPU_DISABLE_PREEMPTION=1 the
+    SAME priority-bearing workload behaves bit-identically to the pre-PR
+    engine (all-default-priority FIFO): the high-priority request waits its
+    turn, overflow submits reject as plain queue_full backpressure, and
+    nothing is ever preempted."""
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8], [9, 10]]
+
+        def run(disable, hi_priority):
+            from perceiver_io_tpu.utils import env_override
+
+            with env_override("PERCEIVER_IO_TPU_DISABLE_PREEMPTION",
+                              "1" if disable else None):
+                engine = _engine(model, params, num_slots=3, kv_page_size=2,
+                                 num_kv_pages=11, max_queue_depth=1)
+            bg = [engine.submit(p, max_new_tokens=4) for p in prompts[:2]]
+            engine.step()  # pool saturated
+            hi = engine.submit(prompts[2], max_new_tokens=4, priority=hi_priority)
+            engine.step()
+            overflow = engine.submit(prompts[3], max_new_tokens=4)  # past bound
+            engine.run_until_drained(max_steps=400)
+            handles = bg + [hi, overflow]
+            return ([h.status.value for h in handles],
+                    [h.result().tolist() for h in handles],
+                    [h.finish_reason for h in handles],
+                    engine.metrics.snapshot())
+
+        # kill-switch arm: priorities present but inert
+        sts_off, toks_off, reasons_off, snap_off = run(True, hi_priority=2)
+        # pre-PR baseline: the same workload at all-default priorities
+        sts_pre, toks_pre, reasons_pre, snap_pre = run(False, hi_priority=0)
+    return {
+        "ok": (
+            (sts_off, toks_off, reasons_off) == (sts_pre, toks_pre, reasons_pre)
+            and snap_off["preemptions"] == 0 == snap_pre["preemptions"]
+            and reasons_off[-1] == "queue_full"  # the pre-PR backpressure
+        ),
+        "bit_identical_to_pre_pr": (sts_off, toks_off) == (sts_pre, toks_pre),
+        "statuses": sts_off,
+        "overflow_reason": reasons_off[-1],
+        "preemptions": [snap_off["preemptions"], snap_pre["preemptions"]],
+    }
+
+
 def check_router_crash_failover() -> dict:
     """A replica crashed mid-decode loses nothing: the victim finishes
     token-identical (f64) to the fault-free run after failover, the survivor
@@ -574,6 +702,8 @@ CHECKS = {
     "serving_nan": check_serving_nan,
     "queue_bound": check_queue_bound,
     "paging_pool_exhaustion": check_paging_pool_exhaustion,
+    "preempt_storm": check_preempt_storm,
+    "preempt_disabled_inert": check_preempt_disabled_inert,
     "router_crash_failover": check_router_crash_failover,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,

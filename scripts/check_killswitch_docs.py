@@ -1,0 +1,106 @@
+"""Docs drift guard: every ``PERCEIVER_IO_TPU_*`` env var the package reads
+must appear in the documentation (docs/*.md or README.md).
+
+The repo's contract is that every kill-switch and env knob is discoverable
+from the docs kill-switch tables (docs/serving.md, docs/training-pipeline.md,
+docs/reliability.md, docs/observability.md). Nothing enforces that at review
+time, so vars drift: a switch added in code but not documented is an
+operator trap — the rollback lever exists and nobody can find it. This
+script greps the package for env-var references and fails when any is
+missing from the docs; it runs in the fast tier as a pytest smoke
+(tests/test_killswitch_docs.py), so the drift is caught on every change.
+
+Pure stdlib and jax-free — runs anywhere the repo is.
+
+Usage: ``python scripts/check_killswitch_docs.py [--json]``; exit 1 when any
+var is undocumented.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a var reference is the prefix plus at least one more identifier char, so a
+# bare "PERCEIVER_IO_TPU_*" glob in prose never counts as a variable
+ENV_VAR_RE = re.compile(r"PERCEIVER_IO_TPU_[A-Z0-9][A-Z0-9_]*")
+
+
+def _scan(paths: List[str]) -> Set[str]:
+    found: Set[str] = set()
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                found.update(ENV_VAR_RE.findall(f.read()))
+        except OSError:
+            continue
+    return found
+
+
+def package_env_vars(repo: str = _REPO) -> Set[str]:
+    """Every PERCEIVER_IO_TPU_* referenced anywhere in the package source."""
+    paths = []
+    for root, _dirs, files in os.walk(os.path.join(repo, "perceiver_io_tpu")):
+        paths.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
+    return _scan(sorted(paths))
+
+
+def documented_env_vars(repo: str = _REPO) -> Set[str]:
+    """Every PERCEIVER_IO_TPU_* mentioned in docs/*.md or README.md."""
+    docs_dir = os.path.join(repo, "docs")
+    paths = [os.path.join(repo, "README.md")]
+    if os.path.isdir(docs_dir):
+        paths.extend(os.path.join(docs_dir, f) for f in sorted(os.listdir(docs_dir))
+                     if f.endswith(".md"))
+    return _scan(paths)
+
+
+def check(repo: str = _REPO) -> Dict:
+    in_package = package_env_vars(repo)
+    in_docs = documented_env_vars(repo)
+    missing = sorted(in_package - in_docs)
+    return {
+        "package_vars": sorted(in_package),
+        "documented_vars": sorted(in_docs),
+        "missing_from_docs": missing,
+        # docs-only vars are reported informationally, not failed: docs may
+        # legitimately describe a var slightly ahead of or behind a rename,
+        # and prose examples (e.g. PERCEIVER_IO_TPU_FAULT specs) are fine
+        "documented_but_unused": sorted(in_docs - in_package),
+        "ok": not missing,
+    }
+
+
+def main(argv=None) -> Dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    result = check()
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(f"{len(result['package_vars'])} env var(s) referenced by the package, "
+              f"{len(result['documented_vars'])} documented")
+        if result["missing_from_docs"]:
+            print("UNDOCUMENTED env var(s) — add them to the docs kill-switch tables:")
+            for var in result["missing_from_docs"]:
+                print(f"  - {var}")
+        else:
+            print("all package env vars are documented")
+        if result["documented_but_unused"]:
+            print("documented but not referenced by the package (informational):")
+            for var in result["documented_but_unused"]:
+                print(f"  - {var}")
+    if not result["ok"] and __name__ == "__main__":
+        raise SystemExit(1)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -189,6 +189,40 @@ def report_bench(path: str) -> Dict:
     return {"source": path, **telemetry}
 
 
+def _lifetimes_by_priority(events: List[Dict]) -> Dict[str, Dict]:
+    """Per-priority-class request-lifetime stats from the v6 event stream:
+    join each ``submit`` (carrying ``priority``) against its terminal
+    ``finish``/``reject`` by request id and aggregate the wall deltas per
+    class. Pre-v6 streams have no ``priority`` on submits — those requests
+    land in the ``"unknown"`` class rather than being silently dropped."""
+    submits: Dict = {}
+    for e in events:
+        if e.get("event") == "submit" and isinstance(e.get("ts"), (int, float)):
+            prio = e.get("priority")
+            submits[e.get("request_id")] = (
+                e["ts"], "unknown" if prio is None else str(prio)
+            )
+    by_class: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("event") not in ("finish", "reject"):
+            continue
+        if not isinstance(e.get("ts"), (int, float)):
+            continue
+        hit = submits.get(e.get("request_id"))
+        if hit is None:
+            continue
+        ts0, prio = hit
+        by_class.setdefault(prio, []).append(e["ts"] - ts0)
+
+    def _stats(xs: List[float]) -> Dict:
+        xs = sorted(xs)
+        return {"count": len(xs), "p50_s": round(xs[len(xs) // 2], 6),
+                "p95_s": round(xs[min(int(len(xs) * 0.95), len(xs) - 1)], 6),
+                "max_s": round(xs[-1], 6)}
+
+    return {prio: _stats(xs) for prio, xs in sorted(by_class.items())}
+
+
 def report_serving_metrics(path: str) -> Dict:
     from perceiver_io_tpu.serving.metrics import load_metrics_jsonl
 
@@ -207,6 +241,13 @@ def report_serving_metrics(path: str) -> Dict:
         alloc_failures = sum(1 for e in loaded["events"] if e.get("event") == "alloc_failure")
         if alloc_failures:
             out["alloc_failure_events"] = alloc_failures
+        # serving-metrics/v6 priority/preemption (None on pre-v6 streams)
+        out["preemptions"] = snap.get("preemptions")
+        out["preempted_replays"] = snap.get("preempted_replays")
+        out["queue_wait_by_priority"] = snap.get("queue_wait_by_priority")
+    lifetimes = _lifetimes_by_priority(loaded["events"])
+    if lifetimes:
+        out["request_lifetimes_by_priority"] = lifetimes
     return out
 
 
@@ -284,6 +325,19 @@ def main(argv=None) -> Dict:
                   f"{pool.get('pages_in_use')}/{pool.get('pages_total')} pages in use, "
                   f"pages/request p50={ppr.get('p50')} p95={ppr.get('p95')}, "
                   f"alloc failures={pool.get('alloc_failures')}")
+        # v6 priority/preemption rendering (suppressed on pre-v6 streams,
+        # where the reader normalized the fields to None)
+        if section.get("preemptions") is not None:
+            print(f"preemptions: {section['preemptions']} "
+                  f"(resumed as replay: {section.get('preempted_replays')})")
+        waits = section.get("queue_wait_by_priority")
+        if waits:
+            for prio, stats in sorted(waits.items()):
+                print(f"  queue wait [class {prio}]: "
+                      f"p50={stats.get('p50')}s p95={stats.get('p95')}s")
+        for prio, stats in (section.get("request_lifetimes_by_priority") or {}).items():
+            print(f"  lifetime [class {prio}]: {stats['count']} requests, "
+                  f"p50={stats['p50_s']}s p95={stats['p95_s']}s max={stats['max_s']}s")
     for section in report["train_metrics"]:
         print(f"\ntrain metrics — {section['source']}:")
         print(json.dumps({k: v for k, v in section.items() if k != "source"}, indent=1))

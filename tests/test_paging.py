@@ -92,6 +92,66 @@ def test_page_pool_deterministic_allocation_and_refcounts():
         pool.retain([0])
 
 
+def test_page_pool_release_validates_before_mutating():
+    """Regression (ISSUE 9 satellite): a double-free MID-LIST must leave the
+    pool untouched — release/retain validate the whole list first, then
+    mutate, so the raise path cannot strand earlier pages half-released."""
+    pool = PagePool(8)
+    held = pool.allocate(3)  # [1, 2, 3]
+    pool.release([2])  # page 2 now free: [held[0], held[2]] = [1, 3] remain
+    before_free = pool.free_pages
+    before_use = pool.pages_in_use
+    with pytest.raises(ValueError, match="double free of page 2"):
+        pool.release([1, 2, 3])  # invalid mid-list: 1 and 3 must NOT release
+    assert pool.free_pages == before_free and pool.pages_in_use == before_use
+    pool.release([1, 3])  # still held exactly once each — state was untouched
+    assert pool.pages_in_use == 0
+    # duplicate ids in ONE call count against the refcount up front
+    p = pool.allocate(1)[0]
+    with pytest.raises(ValueError, match="double free"):
+        pool.release([p, p])
+    assert pool.pages_in_use == 1  # untouched by the rejected call
+    # out-of-range ids are rejected before any mutation, not mid-loop
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.release([p, 999])
+    assert pool.pages_in_use == 1
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.retain([p, -1])
+    pool.release([p])
+    assert pool.pages_in_use == 0
+
+
+def test_page_pool_refcount_interleavings():
+    """The refcount interleavings the prefix-sharing fork (ROADMAP item 3)
+    will lean on: retain -> release -> release ordering, allocate-after-free
+    reissuing lowest ids, retain-after-free raising, and refcount isolation
+    from unrelated alloc/free churn."""
+    pool = PagePool(10)
+    a = pool.allocate(2)  # [1, 2]
+    # retain -> release -> release: the page survives the first release
+    pool.retain([a[0]])
+    pool.release([a[0]])
+    assert pool.pages_in_use == 2  # still held through the second reference
+    assert a[0] not in pool.allocate(2)  # [3, 4]: page 1 is not free
+    pool.release([a[0]])  # second release frees it
+    assert pool.allocate(1) == [a[0]]  # allocate-after-free reissues lowest id
+    # retain on a FREED id raises (and mutates nothing)
+    pool.release([a[1]])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.retain([a[1]])
+    assert pool.allocate(1) == [a[1]]  # still cleanly allocatable
+    # refcounts are unaffected by unrelated alloc/free churn
+    shared = pool.allocate(1)[0]
+    pool.retain([shared])  # refcount 2
+    churn = pool.allocate(3)
+    pool.release(churn)
+    pool.release(pool.allocate(2))
+    pool.release([shared])
+    assert shared not in pool._free  # one reference still held
+    pool.release([shared])
+    assert shared in pool._free
+
+
 def test_pages_for_request_reservation():
     # bucket + generation budget, capped at the window
     assert pages_for_request(6, 4, WINDOW, 3) == pages_for_tokens(10, 3) == 4
