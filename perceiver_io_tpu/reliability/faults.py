@@ -30,6 +30,16 @@ tests and ``scripts/chaos_check.py`` arm:
                              ``KilledMidWrite`` — a preemption mid-flush
   ``checkpoint.corrupt``     truncate the largest file of a just-written
                              checkpoint — a torn write discovered at restore
+  ``serving.journal.torn_write``  stop a request-journal append halfway and
+                             raise (power loss mid-append; the torn tail is
+                             truncated at recovery)
+  ``serving.journal.corrupt_record``  write a journal record whose CRC
+                             disagrees with its body (bit rot, caught by the
+                             reader's checksum — truncates the read there)
+  ``serving.journal.compact.kill``  raise ``KilledMidWrite`` during a journal
+                             compaction/recovery swap; ``slot`` picks the
+                             stage (0 = before the atomic rename, 1 = after
+                             it, before old-generation deletion)
 
 Arming: ``FAULTS.arm(point, after=..., times=..., value=..., slot=...)`` in
 process, or the env ``PERCEIVER_IO_TPU_FAULT="point:key=val,key=val;point2"``
@@ -67,6 +77,9 @@ POINTS = frozenset(
         "checkpoint.write.flaky",
         "checkpoint.write.kill",
         "checkpoint.corrupt",
+        "serving.journal.torn_write",
+        "serving.journal.corrupt_record",
+        "serving.journal.compact.kill",
     }
 )
 
@@ -274,6 +287,22 @@ def fire_replica_tick(replica_id: int) -> None:
         spec = FAULTS.fire(point, target=replica_id)
         if spec is not None:
             time.sleep(spec.value or 0.05)
+
+
+def fire_journal_compact_kill(stage: int) -> None:
+    """Request-journal compaction hook (serving/journal.py). The armed
+    spec's ``slot`` selects the kill point: 0 = after the tmp generation is
+    written but BEFORE the atomic rename (the swap never became durable —
+    the old generation is still the truth), 1 = after the rename but before
+    the superseded generation's segments are deleted (the new generation is
+    the truth; the leftovers must be ignored by readers). Raises
+    ``KilledMidWrite`` at the matching stage."""
+    spec = FAULTS.fire("serving.journal.compact.kill", target=stage)
+    if spec is not None:
+        raise KilledMidWrite(
+            f"injected kill mid-journal-compaction (stage {stage}, firing "
+            f"{spec.fired}{'' if spec.times is None else f'/{spec.times}'})"
+        )
 
 
 def fire_checkpoint_write(path: str) -> None:

@@ -5,8 +5,11 @@ fixed pool of decode slots inside ONE compiled decode step; ``ServingRouter``
 fronts N engine replicas with health-checked dispatch, circuit breakers,
 deterministic failover, and SLO-aware shedding (docs/reliability.md).
 ``SlotScheduler`` owns admission/eviction policy, ``EngineMetrics`` /
-``RouterMetrics`` the observability surface. ``scripts/serve_bench.py``
-drives synthetic workloads through both.
+``RouterMetrics`` the observability surface, and ``RequestJournal`` the
+crash-durability layer (write-ahead accept/token/terminal records;
+``ServingEngine.recover`` / ``ServingRouter.recover`` rebuild every accepted
+session after process death). ``scripts/serve_bench.py`` drives synthetic
+workloads through all of it.
 """
 
 from perceiver_io_tpu.serving.engine import (
@@ -16,6 +19,14 @@ from perceiver_io_tpu.serving.engine import (
     ServingEngine,
     SlotState,
     default_prefill_buckets,
+)
+from perceiver_io_tpu.serving.journal import (
+    JournalCorruptError,
+    JournalSession,
+    JournalTornWrite,
+    RequestJournal,
+    journal_enabled,
+    read_journal,
 )
 from perceiver_io_tpu.serving.metrics import (
     EngineMetrics,
@@ -33,6 +44,12 @@ from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
 __all__ = [
     "EngineMetrics",
+    "JournalCorruptError",
+    "JournalSession",
+    "JournalTornWrite",
+    "RequestJournal",
+    "journal_enabled",
+    "read_journal",
     "PagePool",
     "paged_kv_enabled",
     "pages_for_request",

@@ -57,7 +57,7 @@ Telemetry (docs/observability.md): ``ServingEngine(telemetry=...)`` (or the
 ``PERCEIVER_IO_TPU_TELEMETRY`` env) turns on phase spans per tick (admit /
 prefill dispatch / install / decode dispatch / sample-sync / evict),
 per-request lifecycle spans keyed by request id (joinable against the
-serving-metrics/v6 JSONL events), and a compile watchdog that flags any
+serving-metrics/v7 JSONL events), and a compile watchdog that flags any
 program count growing past the churn-never-recompiles budgets at runtime.
 Off by default; the disabled path holds the shared no-op recorder and the
 greedy-parity and compile-count pins run through it unchanged.
@@ -106,7 +106,17 @@ dead-page skip — the visibility bound is load-bearing there);
 ``PERCEIVER_IO_TPU_DISABLE_PREEMPTION=1`` restores strict submit-order FIFO
 (priorities ignored, no aging, no preemption — behavior bit-identical to
 the pre-priority engine, pinned by the ``preempt_disabled_inert`` chaos
-scenario).
+scenario); ``PERCEIVER_IO_TPU_DISABLE_JOURNAL=1`` makes a configured
+request journal inert — no files touched, behavior bit-identical to
+``journal=None`` (serving/journal.py, tests/test_journal.py).
+
+Crash durability (serving/journal.py; docs/serving.md "Request journal"):
+with ``journal=<dir>`` every accepted request is durable before ``submit``
+returns (write-ahead accept record, fsynced), per-tick emissions and
+terminal outcomes land as one buffered journal write per tick, and
+``ServingEngine.recover(model, params, journal_dir, ...)`` rebuilds the
+queue and all in-flight sessions on a fresh process as forced replays —
+f64 token-identical continuations, zero extra compiled programs.
 
 Greedy engine output is token-identical to ``generate()`` on the same
 canonical form (tests/test_serving.py pins this in float64); sampled output
@@ -136,6 +146,13 @@ from perceiver_io_tpu.reliability import faults
 from perceiver_io_tpu.reliability.preemption import (
     install_preemption_handler,
     restore_preemption_handler,
+)
+from perceiver_io_tpu.serving.journal import (
+    JournalCorruptError,
+    JournalSession,
+    RequestJournal,
+    journal_enabled,
+    read_journal,
 )
 from perceiver_io_tpu.serving.metrics import EngineMetrics
 from perceiver_io_tpu.serving.paging import PagePool, paged_kv_enabled, pages_for_request
@@ -280,6 +297,21 @@ def _engine_compatible(config: GenerationConfig) -> Optional[str]:
     return None
 
 
+# the GenerationConfig fields a servable request can carry (everything
+# _engine_compatible admits); the journal's accept record persists exactly
+# these, and GenerationConfig(**payload) reconstructs an equivalent config —
+# the non-default values of every other field are rejected at submit, so
+# dropping them loses nothing
+_JOURNAL_CONFIG_FIELDS = (
+    "max_new_tokens", "do_sample", "temperature", "top_k", "top_p",
+    "eos_token_id", "pad_token_id",
+)
+
+
+def _journal_config_payload(config: GenerationConfig) -> dict:
+    return {k: getattr(config, k) for k in _JOURNAL_CONFIG_FIELDS}
+
+
 # distinguishes concurrent engines' lifecycle spans in a shared recorder
 _ENGINE_IDS = itertools.count()
 
@@ -323,6 +355,7 @@ class ServingEngine:
         num_kv_pages: Optional[int] = None,
         priority_aging_ticks: Optional[int] = None,
         max_preemptions: int = 2,
+        journal=None,
     ):
         self.model = model
         self.params = params
@@ -355,7 +388,7 @@ class ServingEngine:
         self.metrics = EngineMetrics(num_slots=num_slots, jsonl_path=metrics_jsonl)
         # unified telemetry (docs/observability.md): phase spans per tick,
         # per-request lifecycle spans keyed by request id (joinable against
-        # the serving-metrics/v6 events carrying the same request_id), and a
+        # the serving-metrics/v7 events carrying the same request_id), and a
         # compile watchdog policing the churn-never-recompiles invariant at
         # runtime. Off by default: ``telemetry=None`` defers to the
         # PERCEIVER_IO_TPU_TELEMETRY env, and the disabled surface is the
@@ -381,6 +414,23 @@ class ServingEngine:
             raise ValueError(f"max_queue_depth must be >= 0, got {max_queue_depth}")
         self.max_queue_depth = max_queue_depth
         self.default_deadline_s = default_deadline_s
+        # write-ahead request journal (serving/journal.py, docs/serving.md
+        # "Request journal"): accepted ⇒ durable. ``journal`` is a directory
+        # path (the engine owns a default-policy RequestJournal there) or a
+        # caller-built RequestJournal (custom fsync/segment policy). The
+        # kill-switch forces None — behavior bit-identical to journal=None,
+        # pinned in tests/test_journal.py. Per-tick changes are BUFFERED here
+        # and land as one write per tick (append_tick) so the hot decode loop
+        # pays no per-token journal syscalls.
+        self.journal: Optional[RequestJournal] = None
+        if journal is not None and journal_enabled():
+            self.journal = (journal if isinstance(journal, RequestJournal)
+                            else RequestJournal(os.fspath(journal)))
+        self._journal_admits: List[int] = []
+        self._journal_tokens: Dict[int, List[int]] = {}
+        self._journal_terminals: List[tuple] = []
+        if self.journal is not None:
+            self.metrics.set_journal(self.journal.stats())
         self._draining = False
         # ticks skip the deadline scan entirely until any request carries one
         # — a no-deadline engine with a deep backlog must not pay O(queue)
@@ -856,6 +906,31 @@ class ServingEngine:
         # queue_full backpressure instead of a new failure mode.
         if self.max_queue_depth is not None and self.load >= self.max_queue_depth:
             return self._reject(request, "queue_full")
+        if self.journal is not None:
+            # the durability point (docs/serving.md "Request journal"): the
+            # accept record — prompt, servable config, raw rng key, priority,
+            # TTL, any replay prefix — is on disk (fsynced under the default
+            # policy) BEFORE the handle exists anywhere the caller can see.
+            # Every rejection above returned first: rejected ⇒ never journaled.
+            try:
+                self.journal.append_accept(
+                    request.request_id, prompt.tolist(),
+                    _journal_config_payload(config),
+                    np.asarray(request.rng, np.uint32).reshape(-1).tolist(),
+                    priority=request.priority, deadline_s=request.deadline_s,
+                    replay=request.replay_ids.tolist()
+                    if request.replay_ids is not None else None,
+                )
+            except BaseException:
+                # durability cannot be promised, so the accept must not
+                # stand — but record_submit and the lifecycle span already
+                # fired above, and an exception alone would leave them
+                # dangling forever (submitted != finished+rejected+..., a
+                # leaked async span). Close the accounting as a rejection,
+                # THEN surface the failure. tracks() is False for a failed
+                # append, so _reject's journal-terminal note is a no-op.
+                self._reject(request, "journal_error")
+                raise
         self._requests[request.request_id] = request
         # seq = the monotone request id, so FIFO-within-class is submit order
         # and a later preemption re-queue resumes the same seniority; with
@@ -875,6 +950,10 @@ class ServingEngine:
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
         self.finished.append(request)
+        # pre-acceptance refusals were never journaled (tracks() is False);
+        # a drain-time rejection of an ACCEPTED queued request must journal
+        # its terminal outcome or compaction would carry it forever
+        self._journal_note_terminal(request, RequestStatus.REJECTED, reason)
         self.metrics.record_reject(request.request_id, reason)
         if self._obs_on:
             self._obs.counter_inc(f"{self._obs_ns}.rejected")
@@ -951,6 +1030,11 @@ class ServingEngine:
         request.status = RequestStatus.RUNNING
         request.slot = slot
         request.pages_allocated = pages
+        if self.journal is not None:
+            # buffered; lands with the tick's one journal write. "Admitted"
+            # marks in-flight work: a recovery's drain() finishes it instead
+            # of rejecting it with the never-admitted backlog
+            self._journal_admits.append(request.request_id)
         if request.replay_ids is not None and request.replay_pos < request.replay_ids.size:
             self._replay_slots[slot] = request
         request.admitted_at = now
@@ -970,6 +1054,7 @@ class ServingEngine:
     def _evict(
         self, slot: int, request: ServedRequest, reason: str,
         status: RequestStatus = RequestStatus.FINISHED,
+        journal_terminal: bool = True,
     ) -> None:
         self.scheduler.release(slot)
         self._replay_slots.pop(slot, None)
@@ -993,6 +1078,8 @@ class ServingEngine:
         request.slot = None
         self._requests.pop(request.request_id, None)  # engines are long-lived: no per-request residue
         self.finished.append(request)
+        if journal_terminal:
+            self._journal_note_terminal(request, status, reason)
         self.metrics.record_finish(
             request.request_id, slot, len(request.output_ids), reason,
             status=status.value,
@@ -1006,6 +1093,7 @@ class ServingEngine:
         self, request_id: int, reason: str = "cancelled",
         status: RequestStatus = RequestStatus.FAILED,
         queued_only: bool = False,
+        journal_terminal: bool = True,
     ) -> Optional[ServedRequest]:
         """Cancel one non-terminal request wherever it sits — queued (leaves
         the queue, never costs a prefill) or running (slot released, partial
@@ -1016,14 +1104,20 @@ class ServingEngine:
         probing a suspect engine may not trust yet). This is the eviction API
         the router's failover uses to reclaim a lost replica's stale requests
         (serving/router.py); it is also the building block for client-side
-        cancellation."""
+        cancellation. ``journal_terminal=False`` evicts WITHOUT journaling a
+        terminal record: the router's orphan reclaim passes it for sessions
+        whose failover continuation is still parked fleet-side — this
+        journal's live entry is that continuation's only durable copy, and
+        the router closes it (``_journal_note_moved``) exactly when the
+        continuation lands durably elsewhere or resolves terminally."""
         request = self._requests.get(request_id)
         if request is None:
             return None
         if request.slot is not None:
             if queued_only:
                 return None
-            self._evict(request.slot, request, reason, status=status)
+            self._evict(request.slot, request, reason, status=status,
+                        journal_terminal=journal_terminal)
             return request
         removed = self.scheduler.prune_queue(lambda r: r is request)
         if not removed:  # defensive: _requests said queued but the queue disagrees
@@ -1033,6 +1127,8 @@ class ServingEngine:
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
         self.finished.append(request)
+        if journal_terminal:
+            self._journal_note_terminal(request, status, reason)
         self.metrics.record_evict_queued(request_id, reason, status=status.value,
                                          new_tokens=len(request.output_ids))
         if self._obs_on:
@@ -1177,6 +1273,165 @@ class ServingEngine:
             if not admitted:
                 return  # defensive: the gate disagreed with the selection
 
+    # ----------------------------------------------------------------- journal
+    def _journal_note_terminal(self, request: ServedRequest,
+                               status: RequestStatus, reason: str) -> None:
+        """Buffer one terminal outcome for the tick's journal write — only
+        for requests the journal actually tracks (an accepted request;
+        pre-acceptance rejections never had an accept record)."""
+        if self.journal is not None and self.journal.tracks(request.request_id):
+            self._journal_terminals.append(
+                (request.request_id, status.value, reason)
+            )
+
+    def _journal_flush(self) -> None:
+        """Land the tick's buffered admissions / tokens / terminals as ONE
+        journal write, and refresh the v7 journal gauges."""
+        if self.journal is None or self.journal.failed:
+            # fail-stopped journal (an append died mid-line): nothing more
+            # can land; close() must still succeed so the caller can move to
+            # recovery, which reads the durable prefix. The tick buffers are
+            # DROPPED, not retained — they can never be written, and a caller
+            # that keeps stepping the degraded engine must not grow them by
+            # one entry per emitted token for the rest of the process
+            self._journal_admits = []
+            self._journal_tokens = {}
+            self._journal_terminals = []
+            return
+        if self._journal_admits or self._journal_tokens or self._journal_terminals:
+            self.journal.append_tick(self._journal_admits, self._journal_tokens,
+                                     self._journal_terminals)
+            self._journal_admits = []
+            self._journal_tokens = {}
+            self._journal_terminals = []
+        self.metrics.set_journal(self.journal.stats())
+
+    def _recover_attach(self, journal_path, fsync: str = "accept",
+                        segment_max_records: int = 4096) -> dict:
+        """Core of ``recover()``: replay a journal directory into THIS
+        (freshly constructed, journal-less, empty) engine, then atomically
+        swap the journal to a new generation reflecting the recovered state
+        and attach it for ongoing appends. Split out so ``ServingRouter.
+        recover`` can run it per replica engine.
+
+        Order is the crash-safety argument: the old generation on disk stays
+        untouched until every live session is re-submitted and the new
+        generation's rename lands — a crash ANYWHERE during recovery leaves
+        the old generation the durable truth and a re-run recovers
+        identically. Re-submitted sessions keep their original priority
+        class, and accept order + the engine's monotone request ids preserve
+        original seniority within each class; emitted tokens ride in as the
+        forced-replay stream (the router-failover mux), so recovered
+        continuations are f64 token-identical to an uninterrupted run — rng
+        chain included — and replay compiles nothing beyond the standard
+        per-bucket programs. Sessions that had EVER reached a slot resume as
+        ``PREEMPTED`` continuations (in-flight work a process death
+        displaced): ``drain()`` finishes them, while never-admitted queue
+        entries reject as backlog — the established drain contract."""
+        if self.journal is not None or self._requests or self.scheduler.has_work:
+            raise JournalCorruptError(
+                "recovery needs a fresh journal-less engine (construct with "
+                "journal=None and no submitted work)"
+            )
+        journal_path = os.path.abspath(os.fspath(journal_path))
+        state = read_journal(journal_path)
+        handles: List[ServedRequest] = []
+        mirror = []
+        now = time.time()
+        saved_bound = self.max_queue_depth
+        # accepted work is never killed by the queue bound (the router's
+        # requeue discipline): the bound gates NEW admissions, and every one
+        # of these was already accepted before the process died
+        self.max_queue_depth = None
+        try:
+            for session in state.sessions:
+                emitted = session.emitted
+                handle = self.submit(
+                    session.prompt,
+                    config=GenerationConfig(**session.config),
+                    rng=np.asarray(session.rng, np.uint32),
+                    deadline_s=session.remaining_deadline(now),
+                    replay_ids=emitted if emitted else None,
+                    priority=session.priority,
+                )
+                if handle.status is RequestStatus.REJECTED:  # defensive: it fit once
+                    raise JournalCorruptError(
+                        f"recovered session rid={session.rid} rejected "
+                        f"({handle.finish_reason}) — engine geometry does not "
+                        f"match the journaled fleet"
+                    )
+                if session.admitted:
+                    handle.status = RequestStatus.PREEMPTED
+                # the handle carries the salvage from tick one, exactly like
+                # an intra-engine preemption victim (which keeps output_ids
+                # alongside replay_ids): if the TTL expires before the
+                # continuation re-admits, the terminal event and result()
+                # still surface the journaled partial tokens instead of
+                # silently dropping work the journal durably holds. Replay
+                # re-emission appends only PAST len(output_ids), so the
+                # stream stays monotonic and nothing double-counts.
+                handle.output_ids = [int(t) for t in emitted]
+                handles.append(handle)
+                # the new generation's view of this session: the NEW request
+                # id, the remaining TTL re-anchored at recovery time, and the
+                # whole emitted prefix folded into the replay field
+                mirror.append((handle.request_id, JournalSession(
+                    rid=handle.request_id, prompt=session.prompt,
+                    config=session.config, rng=session.rng,
+                    priority=session.priority, deadline_s=handle.deadline_s,
+                    accepted_ts=now, admitted=session.admitted,
+                    replay=emitted, tokens=[],
+                )))
+        finally:
+            self.max_queue_depth = saved_bound
+        replayed = sum(len(s.emitted) for s in state.sessions)
+        if journal_enabled():
+            self.journal = RequestJournal(
+                journal_path, fsync=fsync,
+                segment_max_records=segment_max_records,
+                _recovered_from=state, _sessions=mirror,
+            )
+            self.metrics.set_journal(self.journal.stats())
+        self.metrics.record_recovery(
+            sessions=len(handles), replayed_tokens=replayed,
+            truncated=state.truncated, dropped_records=state.dropped_records,
+            generation=state.generation,
+        )
+        if self._obs_on:
+            self._obs.counter_inc(f"{self._obs_ns}.sessions_recovered",
+                                  len(handles))
+        return {
+            "sessions": len(handles),
+            "replayed_tokens": replayed,
+            "in_flight": sum(1 for s in state.sessions if s.admitted),
+            "truncated": state.truncated,
+            "dropped_records": state.dropped_records,
+            "records": state.records,
+            "generation": state.generation,
+            "handles": handles,
+        }
+
+    @classmethod
+    def recover(cls, model, params, journal, fsync: str = "accept",
+                segment_max_records: int = 4096, **engine_kwargs):
+        """Rebuild a serving engine from a write-ahead journal after process
+        death (docs/serving.md "Request journal"): every accepted,
+        non-terminal request re-enters the queue at its original priority
+        and seniority as a prompt + emitted-token replay. Returns
+        ``(engine, info)`` where ``info["handles"]`` are the recovered
+        request handles in original accept order; step/drain the engine as
+        usual and each completes f64 token-identical to an uninterrupted
+        run. ``engine_kwargs`` must describe the same pool geometry the dead
+        process ran (slot count, buckets, paging) — the journal records
+        requests, not engine configuration. With the
+        ``PERCEIVER_IO_TPU_DISABLE_JOURNAL`` kill-switch set, recovery still
+        REBUILDS the sessions (an explicit call to read explicit state) but
+        attaches no journal and leaves the directory untouched."""
+        engine = cls(model, params, journal=None, **engine_kwargs)
+        info = engine._recover_attach(journal, fsync=fsync,
+                                      segment_max_records=segment_max_records)
+        return engine, info
+
     # --------------------------------------------------------------- deadlines
     def _expire_deadlines(self, now: float) -> None:
         """Tick-boundary TTL enforcement: expired QUEUED requests leave the
@@ -1194,6 +1449,7 @@ class ServingEngine:
             request.finish_reason = "deadline"
             request.finished_at = now
             self.finished.append(request)
+            self._journal_note_terminal(request, RequestStatus.TIMED_OUT, "deadline")
             # a PREEMPTED continuation expiring in the queue DID hold a slot:
             # its emitted tokens ride the terminal event (0 for the
             # never-admitted case), keeping the stream's accounting honest
@@ -1310,6 +1566,9 @@ class ServingEngine:
         returning ``has_work`` when nothing was dispatched."""
         pending, self._pending_harvest = self._pending_harvest, None
         if pending is None:
+            # ticks with no dispatch still flush: a drain that rejected the
+            # backlog on an idle engine must journal those terminals now
+            self._journal_flush()
             self._maybe_flush_preempted()
             return self.scheduler.has_work
         try:
@@ -1379,6 +1638,16 @@ class ServingEngine:
                         del self._replay_slots[slot]
                 else:
                     request.output_ids.append(token)
+                    if self.journal is not None:
+                        # only FREE-RUNNING emissions are journaled: a
+                        # replayed token is already covered by its accept
+                        # record's replay prefix (failover/recovery) or by the
+                        # tick record that journaled its first emission
+                        # (preemption resume) — journaling it again would
+                        # duplicate it in the recovered stream
+                        self._journal_tokens.setdefault(
+                            request.request_id, []
+                        ).append(token)
                 cfg = request.config
                 if cfg.eos_token_id is not None and token == cfg.eos_token_id:
                     self._evict(slot, request, "eos")
@@ -1390,6 +1659,10 @@ class ServingEngine:
             # (counter compile.unexpected + instant trace event), never raised
             self.watchdog.check()
         self._obs.span_end(self._span_tick)
+        # the tick's ONE journal write: admissions + emitted tokens +
+        # terminal outcomes, buffered above, land together (flushed; fsynced
+        # only under fsync="always" — docs/serving.md "Request journal")
+        self._journal_flush()
         self._maybe_flush_preempted()
         return self.scheduler.has_work
 
@@ -1485,6 +1758,11 @@ class ServingEngine:
         Idempotent; caller-owned recorders are left open."""
         restore_preemption_handler(self._preempt_handler, self._preempt_previous)
         self._preempt_handler = None
+        if self.journal is not None:
+            # land any buffered tick state, then fsync+close: a graceful
+            # shutdown leaves the journal byte-complete for the next process
+            self._journal_flush()
+            self.journal.close()
         self.metrics.close()
         if self.watchdog is not None:
             self.watchdog.close()

@@ -1,0 +1,647 @@
+"""Crash-durable serving: an append-only write-ahead request journal.
+
+Every in-process failure the serving stack survives — replica crash/stall
+failover, NaN quarantine, deadlines, page-pool preemption — shares one
+assumption: SOME process is still alive to run the recovery machinery. A
+process death (kill -9, OOM, host reboot) violates it and loses every
+accepted session. This module extends the checkpoint-lineage durability
+discipline (CRC manifests, atomic tmp+rename, kill-point analysis —
+training/checkpoint.py, docs/reliability.md) from training state to serving
+state: **accepted ⇒ durable**. ``ServingEngine(journal=...)`` appends an
+``accept`` record before ``submit()`` returns a handle, batches the per-tick
+emitted-token / admission / terminal records into ONE buffered write per tick
+(the hot decode loop pays no per-token fsync), and
+``ServingEngine.recover(...)`` rebuilds the queue and every in-flight session
+on a fresh process as prompt + emitted-token replay — the router-failover
+forced-decode mux, so recovered continuations are f64 token-identical to an
+uninterrupted run (rng chain included) and replay compiles zero programs
+beyond the standard set (docs/serving.md "Request journal").
+
+On-disk format (docs/reliability.md carries the full record table):
+
+  * the journal is a DIRECTORY of JSONL segments named
+    ``seg-<gen:04d>-<idx:06d>.jsonl``. Only the highest **generation**
+    present is live; lower generations are superseded leftovers of an
+    interrupted compaction/recovery swap and are ignored by readers and
+    deleted opportunistically by writers.
+  * each line is ``{"crc": <crc32 of the canonical record JSON>, "r":
+    {record}}`` where the record carries a **monotone seq** (0, 1, 2, ...
+    within its generation — a gap, repeat, parse failure, or CRC mismatch
+    marks the record bad). Reading TRUNCATES at the first bad record: that
+    record and everything after it (the torn tail of a power loss, or the
+    blast radius of mid-segment bit rot) is dropped, counted, and reported —
+    never silently skipped over, because records after a hole can reference
+    state the hole lost.
+  * record types: ``meta`` (schema + engine geometry, first record of every
+    generation), ``accept`` (the durable admission contract: prompt, the
+    servable GenerationConfig fields, raw rng key data, priority class,
+    remaining deadline TTL, any replay prefix the submit carried),
+    ``tick`` (one per engine tick with anything to report: ``admitted`` rids,
+    ``tokens`` {rid: [newly emitted]}, ``terminal`` [[rid, status, reason]]).
+  * **fsync policy** (``fsync=``): ``"accept"`` (default) fsyncs accept
+    records — the accepted⇒durable contract — and leaves tick batches to the
+    OS (flushed per tick, fsynced at rotation/compaction/close: a crash can
+    cost the last few *ticks* of progress but never an accepted request);
+    ``"always"`` additionally fsyncs every tick batch; ``"never"`` only
+    flushes (tests, benchmarks).
+  * **rotation + compaction**: when the active segment reaches
+    ``segment_max_records`` appends, the journal either seals it and starts
+    the next segment, or — when terminal requests have accumulated —
+    COMPACTS: the in-memory live-session mirror is serialized as generation
+    N+1 (one ``accept`` per live request with its emitted prefix folded into
+    the ``replay`` field) via tmp + fsync + atomic rename + parent-directory
+    fsync, and only then are the generation-N segments deleted. A kill at
+    any byte leaves either generation N intact (rename not yet durable) or
+    generation N+1 complete (rename durable; N's leftovers ignored) — the
+    checkpoint-lineage kill-point argument, re-run here.
+
+Recovery (``read_journal`` + ``ServingEngine.recover``) re-submits live
+sessions in accept order at their original priority class — the engine's
+monotone request ids preserve original seniority inside each class — and the
+swap-to-new-generation runs AFTER the engine holds every session, so a crash
+during recovery itself re-recovers from the untouched old generation.
+
+Kill-switch: ``PERCEIVER_IO_TPU_DISABLE_JOURNAL=1`` makes a configured
+journal inert (no directory is touched, engine behavior bit-identical to
+``journal=None`` — pinned in tests/test_journal.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.utils import fsync_dir
+
+SCHEMA = "request-journal/v1"
+DISABLE_ENV = "PERCEIVER_IO_TPU_DISABLE_JOURNAL"
+
+# widths are MINIMA: the writer zero-pads to 4/6 digits but a long-lived
+# journal can outgrow them (each compaction bumps the generation), and a
+# fixed-width pattern would make every reader silently ignore gen >= 10000 —
+# an accepted-=>-durable violation with no error
+_SEG_RE = re.compile(r"^seg-(\d{4,})-(\d{6,})\.jsonl$")
+_FSYNC_POLICIES = ("accept", "always", "never")
+
+
+def journal_enabled() -> bool:
+    """Kill-switch: ``PERCEIVER_IO_TPU_DISABLE_JOURNAL=1`` makes every
+    configured journal inert — the engine behaves bit-identically to
+    ``journal=None`` (no files written, no recovery source). Checked at
+    engine construction, like the paged-KV and preemption switches."""
+    return os.environ.get(DISABLE_ENV, "0").lower() in ("0", "false", "")
+
+
+class JournalCorruptError(RuntimeError):
+    """The journal directory cannot be opened safely (e.g. opening a
+    non-empty journal for FRESH appends without recovery — request ids would
+    collide with the existing accept records)."""
+
+
+class JournalTornWrite(RuntimeError):
+    """Injected power loss mid-append (``serving.journal.torn_write``): the
+    bytes of the current record stop halfway and the process "dies"."""
+
+
+def encode_record(record: dict) -> str:
+    """One journal line: the record under ``"r"`` plus the CRC32 of its
+    canonical (sorted-keys, no-whitespace) JSON serialization. Canonical
+    form on both sides makes the checksum byte-stable across writers."""
+    body = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    return json.dumps(
+        {"crc": zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF, "r": record},
+        sort_keys=True, separators=(",", ":"),
+    )
+
+
+def decode_record(line: str) -> Optional[dict]:
+    """The record, or None for a bad line (parse failure, missing fields,
+    CRC mismatch) — the reader treats None as the start of the torn tail."""
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    if not isinstance(obj, dict) or "crc" not in obj or "r" not in obj:
+        return None
+    record = obj["r"]
+    if not isinstance(record, dict):
+        return None
+    body = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    if (zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF) != obj["crc"]:
+        return None
+    return record
+
+
+def _segments(path: str) -> Dict[int, List[Tuple[int, str]]]:
+    """gen -> [(idx, filepath)] sorted, ignoring tmp/foreign files."""
+    gens: Dict[int, List[Tuple[int, str]]] = {}
+    if not os.path.isdir(path):
+        return gens
+    for name in sorted(os.listdir(path)):
+        m = _SEG_RE.match(name)
+        if m:
+            gens.setdefault(int(m.group(1)), []).append(
+                (int(m.group(2)), os.path.join(path, name))
+            )
+    for segs in gens.values():
+        segs.sort()
+    return gens
+
+
+@dataclass
+class JournalSession:
+    """One live (non-terminal) session reconstructed from the journal: the
+    full durable admission contract plus everything emitted since."""
+
+    rid: int
+    prompt: List[int]
+    config: Dict
+    rng: List[int]
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    accepted_ts: float = 0.0
+    admitted: bool = False  # ever reached a slot (drain keeps such work)
+    replay: List[int] = field(default_factory=list)  # prefix from the accept
+    tokens: List[int] = field(default_factory=list)  # journaled emissions
+
+    @property
+    def emitted(self) -> List[int]:
+        """The session's full known token stream: the accept record's replay
+        prefix (a failover/recovery inheritance) plus every journaled
+        emission — exactly what the recovered engine force-replays."""
+        return self.replay + self.tokens
+
+    def remaining_deadline(self, now: Optional[float] = None) -> Optional[float]:
+        """TTL left as of ``now`` (wall clock — ``perf_counter`` does not
+        survive the process): deadlines keep counting through the outage, so
+        a request that died of old age while the process was down expires at
+        the recovered engine's first tick instead of being resurrected."""
+        if self.deadline_s is None:
+            return None
+        now = time.time() if now is None else now
+        return max(self.deadline_s - (now - self.accepted_ts), 0.0)
+
+
+@dataclass
+class JournalState:
+    """``read_journal``'s result: live sessions in accept order + stats."""
+
+    sessions: List[JournalSession]
+    generation: int
+    records: int  # good records read
+    terminal: int  # accepted requests that reached a terminal status
+    truncated: bool  # a bad record cut the tail
+    dropped_records: int  # lines at/after the first bad record
+    segments: int
+
+
+def read_journal(path: str) -> JournalState:
+    """Replay the newest generation's records into live-session state.
+
+    Torn-tail tolerance: the first bad record (parse/CRC failure, seq gap or
+    repeat) TRUNCATES the read — it and every later line are dropped and
+    counted, because a record after a hole may reference state the hole lost
+    (a token for an accept that vanished). The truncation point is reported,
+    never silently healed; physical cleanup happens at the next
+    generation swap, which rewrites only what was readable."""
+    gens = _segments(path)
+    if not gens:
+        return JournalState(sessions=[], generation=0, records=0, terminal=0,
+                            truncated=False, dropped_records=0, segments=0)
+    gen = max(gens)
+    live: Dict[int, JournalSession] = {}
+    order: List[int] = []
+    records = terminal = dropped = 0
+    truncated = False
+    next_seq = 0
+    for _idx, seg_path in gens[gen]:
+        with open(seg_path, encoding="utf-8") as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            if truncated:
+                dropped += 1
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            record = decode_record(line)
+            if record is None or record.get("seq") != next_seq:
+                truncated = True
+                dropped += 1
+                continue
+            next_seq += 1
+            records += 1
+            kind = record.get("type")
+            if kind == "meta":
+                continue
+            if kind == "accept":
+                rid = record["rid"]
+                live[rid] = JournalSession(
+                    rid=rid,
+                    prompt=list(record["prompt"]),
+                    config=dict(record["config"]),
+                    rng=list(record["rng"]),
+                    priority=int(record.get("priority", 0)),
+                    deadline_s=record.get("deadline_s"),
+                    accepted_ts=float(record.get("ts", 0.0)),
+                    admitted=bool(record.get("admitted", False)),
+                    replay=list(record.get("replay") or []),
+                )
+                order.append(rid)
+            elif kind == "tick":
+                for rid in record.get("admitted") or []:
+                    if rid in live:
+                        live[rid].admitted = True
+                for rid_s, toks in (record.get("tokens") or {}).items():
+                    rid = int(rid_s)
+                    if rid in live:
+                        live[rid].tokens.extend(int(t) for t in toks)
+                for rid, _status, _reason in record.get("terminal") or []:
+                    if live.pop(int(rid), None) is not None:
+                        terminal += 1
+            # unknown record types are tolerated (forward compatibility):
+            # their CRC and seq validated, their content ignored
+    sessions = [live[rid] for rid in order if rid in live]
+    return JournalState(
+        sessions=sessions, generation=gen, records=records, terminal=terminal,
+        truncated=truncated, dropped_records=dropped,
+        segments=len(gens[gen]),
+    )
+
+
+class RequestJournal:
+    """Append-side of the write-ahead journal; owned by one ``ServingEngine``.
+
+    A fresh journal refuses a non-empty directory (appending request ids
+    from 0 would collide with the existing accept records — that state is a
+    RECOVERY source, not an append target; use ``ServingEngine.recover``).
+    The in-memory live-session mirror tracks exactly what a reader would
+    reconstruct, so compaction serializes the mirror instead of re-reading
+    segments."""
+
+    def __init__(self, path: str, fsync: str = "accept",
+                 segment_max_records: int = 4096,
+                 _recovered_from: Optional[JournalState] = None,
+                 _sessions: Optional[Sequence[Tuple[int, JournalSession]]] = None):
+        if fsync not in _FSYNC_POLICIES:
+            raise ValueError(f"fsync must be one of {_FSYNC_POLICIES}, got {fsync!r}")
+        if segment_max_records < 2:
+            raise ValueError(
+                f"segment_max_records must be >= 2, got {segment_max_records}"
+            )
+        self.path = os.path.abspath(os.fspath(path))
+        self.fsync = fsync
+        self.segment_max_records = segment_max_records
+        # observability counters (serving-metrics/v7 journal gauges)
+        self.bytes_written = 0
+        self.records_appended = 0
+        self.fsyncs = 0
+        self.compactions = 0
+        self.sessions_recovered = 0
+        self.replayed_tokens = 0
+        # live mirror: rid -> session, in accept order (python dicts preserve
+        # insertion order — compaction and readers agree on seniority)
+        self._live: Dict[int, JournalSession] = {}
+        self._terminal_since_compact = 0
+        self._file = None
+        self._records_in_seg = 0
+        self._closed = False
+        # set when an append dies mid-line (real I/O error or the injected
+        # torn write): the tail state is unknown, so the journal refuses
+        # further appends instead of merging the next record into the tear
+        self._failed = False
+        os.makedirs(self.path, exist_ok=True)
+        if _recovered_from is not None:
+            # recovery swap: serialize the recovered engine's sessions (new
+            # request ids) as generation old+1, atomically — the old
+            # generation stays the durable truth until the rename lands
+            self._gen = _recovered_from.generation + 1
+            self._seg_idx = 0
+            self._next_seq = 0
+            self.sessions_recovered = len(_sessions or ())
+            self.replayed_tokens = sum(
+                len(s.emitted) for _rid, s in (_sessions or ())
+            )
+            self._write_generation(_sessions or ())
+        else:
+            if _segments(self.path):
+                raise JournalCorruptError(
+                    f"journal directory {self.path} is not empty — it holds "
+                    f"accepted state; recover it (ServingEngine.recover) "
+                    f"instead of opening it for fresh appends"
+                )
+            self._gen = 1
+            self._seg_idx = 0
+            self._next_seq = 0
+            self._open_segment()
+            self._append({"type": "meta", "schema": SCHEMA,
+                          "created": round(time.time(), 6)})
+            self._sync()
+
+    # -------------------------------------------------------------- low level
+    def _seg_path(self, gen: int, idx: int) -> str:
+        return os.path.join(self.path, f"seg-{gen:04d}-{idx:06d}.jsonl")
+
+    def _open_segment(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        self._file = open(self._seg_path(self._gen, self._seg_idx), "a",
+                          encoding="utf-8")
+        self._records_in_seg = 0
+        fsync_dir(self.path)  # the new segment's name must survive a crash
+
+    def _append(self, record: dict) -> None:
+        """Append one CRC'd record at the next seq. The torn-write and
+        corrupt-record fault points live here: ``serving.journal.torn_write``
+        stops the bytes halfway and raises (power loss mid-append);
+        ``serving.journal.corrupt_record`` writes a complete line whose CRC
+        is wrong (bit rot, discovered only at read time)."""
+        if self._failed:
+            raise JournalCorruptError(
+                f"journal {self.path} is fail-stopped after a failed append "
+                f"(the on-disk tail state is unknown; recover, don't append)"
+            )
+        record = {"seq": self._next_seq, **record}
+        line = encode_record(record) + "\n"
+        spec = faults.FAULTS.fire("serving.journal.torn_write")
+        if spec is not None:
+            self._failed = True
+            self._file.write(line[: max(len(line) // 2, 1)])
+            self._file.flush()
+            raise JournalTornWrite(
+                f"injected torn write at seq {self._next_seq} in {self.path}"
+            )
+        if faults.FAULTS.fire("serving.journal.corrupt_record") is not None:
+            # a complete line whose stored CRC disagrees with its body by one
+            # bit — bit rot that only a checksumming reader can catch
+            body = json.dumps(record, sort_keys=True, separators=(",", ":"))
+            line = json.dumps(
+                {"crc": (zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF) ^ 0x1,
+                 "r": record},
+                sort_keys=True, separators=(",", ":"),
+            ) + "\n"
+        try:
+            self._file.write(line)
+        except BaseException:
+            # a REAL failed write (ENOSPC, EIO) may have left a partial line
+            # at the tail; appending more would merge the next record into it
+            # and make everything after the tear unrecoverable. FAIL-STOP:
+            # the journal refuses further appends (submit propagates the
+            # error), and the durable prefix on disk stays recoverable.
+            self._failed = True
+            raise
+        self._next_seq += 1
+        self._records_in_seg += 1
+        self.records_appended += 1
+        self.bytes_written += len(line)
+
+    def _flush(self) -> None:
+        try:
+            self._file.flush()
+        except BaseException:
+            # a failed flush may have landed any prefix of the buffered
+            # bytes — the same unknown-tail state as a failed write(), so
+            # the same FAIL-STOP: a retried append_tick must not re-append
+            # the tick's buffered tokens (a duplicated recovered stream) or
+            # merge the next record into a torn line
+            self._failed = True
+            raise
+        # NOTE: a flush that raised mid-way may still have written complete
+        # records; recovery reads whatever durable prefix survives
+
+    def _sync(self) -> None:
+        try:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        except BaseException:
+            # after a failed fsync the page-cache/disk state is UNKNOWN
+            # (fsyncgate): the record may or may not be durable. FAIL-STOP.
+            # An errored submit is therefore at-least-once — its accept may
+            # survive on disk and recovery may resurrect it; the caller was
+            # told the submit FAILED, never that the request was dropped.
+            self._failed = True
+            raise
+        self.fsyncs += 1
+
+    # -------------------------------------------------------------- appending
+    @property
+    def failed(self) -> bool:
+        """True once an append died mid-line: the journal is fail-stopped
+        (appends raise; the durable prefix on disk remains recoverable)."""
+        return self._failed
+
+    def tracks(self, rid: int) -> bool:
+        """True while ``rid`` has a live accept record (terminal not yet
+        journaled) — the engine's guard for which terminal outcomes must be
+        journaled (pre-acceptance rejections never had an accept record)."""
+        return rid in self._live
+
+    @property
+    def live_sessions(self) -> int:
+        return len(self._live)
+
+    def append_accept(self, rid: int, prompt: Sequence[int], config: Dict,
+                      rng: Sequence[int], priority: int = 0,
+                      deadline_s: Optional[float] = None,
+                      replay: Optional[Sequence[int]] = None,
+                      admitted: bool = False) -> None:
+        """The durability point of ``submit()``: once this returns, the
+        request survives process death. Fsynced under the default policy —
+        accepted ⇒ durable is the contract, and accepts are per-request (not
+        per-token), so the fsync cost scales with admission rate, not decode
+        rate."""
+        if self._closed:
+            raise JournalCorruptError(f"journal {self.path} is closed")
+        session = JournalSession(
+            rid=rid, prompt=[int(t) for t in prompt], config=dict(config),
+            rng=[int(x) for x in rng], priority=int(priority),
+            deadline_s=deadline_s, accepted_ts=time.time(),
+            admitted=admitted, replay=[int(t) for t in (replay or [])],
+        )
+        record = {
+            "type": "accept", "rid": rid, "prompt": session.prompt,
+            "config": session.config, "rng": session.rng,
+            "priority": session.priority, "ts": round(session.accepted_ts, 6),
+        }
+        if session.deadline_s is not None:
+            record["deadline_s"] = session.deadline_s
+        if session.replay:
+            record["replay"] = session.replay
+        if admitted:
+            record["admitted"] = True
+        self._append(record)
+        if self.fsync in ("accept", "always"):
+            self._sync()
+        else:
+            self._flush()
+        self._live[rid] = session
+        self._maybe_rotate()
+
+    def append_tick(self, admitted: Sequence[int],
+                    tokens: Dict[int, List[int]],
+                    terminal: Sequence[Tuple[int, str, str]]) -> None:
+        """One buffered write per engine tick covering everything the tick
+        changed: admissions, per-request emitted tokens, terminal outcomes.
+        Flushed always (a reader sees the tick), fsynced only under
+        ``fsync="always"`` — the hot decode loop pays no per-token fsync."""
+        if self._closed:
+            raise JournalCorruptError(f"journal {self.path} is closed")
+        if not (admitted or tokens or terminal):
+            return
+        record: Dict = {"type": "tick"}
+        if admitted:
+            record["admitted"] = [int(r) for r in admitted]
+        if tokens:
+            record["tokens"] = {str(r): [int(t) for t in ts]
+                                for r, ts in tokens.items()}
+        if terminal:
+            record["terminal"] = [[int(r), str(s), str(why)]
+                                  for r, s, why in terminal]
+        self._append(record)
+        if self.fsync == "always":
+            self._sync()
+        else:
+            self._flush()
+        for rid in admitted:
+            if rid in self._live:
+                self._live[rid].admitted = True
+        for rid, ts in tokens.items():
+            if rid in self._live:
+                self._live[rid].tokens.extend(int(t) for t in ts)
+        for rid, _status, _reason in terminal:
+            if self._live.pop(rid, None) is not None:
+                self._terminal_since_compact += 1
+        self._maybe_rotate()
+
+    # ----------------------------------------------------- rotation/compaction
+    def _maybe_rotate(self) -> None:
+        """At ``segment_max_records`` appends: COMPACT when terminal requests
+        have accumulated since the last compaction (their records are dead
+        weight every recovery would re-read), otherwise just seal the segment
+        and start the next — all records are live, rewriting buys nothing."""
+        if self._records_in_seg < self.segment_max_records:
+            return
+        if self._terminal_since_compact > 0:
+            self.compact()
+        else:
+            self._sync()  # a sealed segment's bytes must be durable
+            self._seg_idx += 1
+            self._open_segment()
+
+    def compact(self) -> None:
+        """Serialize the live mirror as the next generation and drop the old
+        one. Crash-safe at every byte (docs/reliability.md kill-point table):
+        tmp write + fsync, atomic rename, parent-dir fsync, THEN old-segment
+        deletion — a kill before the rename leaves the old generation the
+        durable truth; after it, the new generation is complete and readers
+        ignore the lower-numbered leftovers."""
+        self._sync()
+        self._file.close()
+        self._file = None
+        self._gen += 1
+        self._seg_idx = 0
+        self._next_seq = 0
+        self._write_generation(list(self._live.items()))
+        self._terminal_since_compact = 0
+        self.compactions += 1
+
+    def _write_generation(self, sessions: Sequence[Tuple[int, JournalSession]]) -> None:
+        """Write one complete generation-``self._gen`` segment holding a meta
+        record plus one accept per session (emitted prefix folded into
+        ``replay``), atomically, then delete superseded generations and leave
+        the journal open for appends on the new segment."""
+        target = self._seg_path(self._gen, self._seg_idx)
+        tmp = target + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            records: List[dict] = [{"seq": 0, "type": "meta", "schema": SCHEMA,
+                                    "created": round(time.time(), 6)}]
+            for rid, session in sessions:
+                record = {
+                    "seq": len(records), "type": "accept", "rid": rid,
+                    "prompt": session.prompt, "config": session.config,
+                    "rng": session.rng, "priority": session.priority,
+                    "ts": round(session.accepted_ts, 6),
+                }
+                if session.deadline_s is not None:
+                    record["deadline_s"] = session.deadline_s
+                emitted = session.emitted
+                if emitted:
+                    record["replay"] = emitted
+                if session.admitted:
+                    record["admitted"] = True
+                records.append(record)
+            for record in records:
+                line = encode_record(record) + "\n"
+                f.write(line)
+                self.bytes_written += len(line)
+            f.flush()
+            os.fsync(f.fileno())
+            self.fsyncs += 1
+        faults.fire_journal_compact_kill(stage=0)  # before the swap is durable
+        os.replace(tmp, target)
+        fsync_dir(self.path)
+        faults.fire_journal_compact_kill(stage=1)  # swapped, leftovers remain
+        for gen, segs in _segments(self.path).items():
+            if gen < self._gen:
+                for _idx, seg_path in segs:
+                    os.remove(seg_path)
+        fsync_dir(self.path)
+        # reopen the swapped segment for appends; seqs continue after it
+        self._next_seq = len(sessions) + 1
+        self.records_appended += len(sessions) + 1
+        self._file = open(target, "a", encoding="utf-8")
+        self._records_in_seg = len(sessions) + 1
+        # rebuild the mirror in the folded form a reader of the new
+        # generation would hold (tokens now live in the replay prefix)
+        self._live = {
+            rid: JournalSession(
+                rid=rid, prompt=session.prompt, config=session.config,
+                rng=session.rng, priority=session.priority,
+                deadline_s=session.deadline_s, accepted_ts=session.accepted_ts,
+                admitted=session.admitted, replay=session.emitted, tokens=[],
+            )
+            for rid, session in sessions
+        }
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> Dict:
+        """The serving-metrics/v7 ``journal`` gauge block."""
+        return {
+            "path": self.path,
+            "fsync": self.fsync,
+            "bytes_written": self.bytes_written,
+            "records_appended": self.records_appended,
+            "fsyncs": self.fsyncs,
+            "compactions": self.compactions,
+            "live_sessions": len(self._live),
+            "generation": self._gen,
+            "sessions_recovered": self.sessions_recovered,
+            "replayed_tokens": self.replayed_tokens,
+        }
+
+    def close(self) -> None:
+        """Flush + fsync + close. Idempotent; a closed journal refuses
+        appends (the owner engine is gone — resurrecting the handle would
+        hide a lifecycle bug)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._file is not None:
+            try:
+                self._sync()
+            except (OSError, ValueError):
+                pass  # a handle torn down by interpreter exit is already closed
+            self._file.close()
+            self._file = None
+
+    def __del__(self):  # best-effort backstop; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass

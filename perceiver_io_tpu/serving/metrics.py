@@ -65,6 +65,16 @@ Schema history:
     a resume). Router snapshots aggregate ``preemptions`` /
     ``preempted_replays`` over their replica sections. The reader normalizes
     pre-v6 snapshots with ``None`` — the v2→v3 discipline throughout.
+  * ``serving-metrics/v7`` — the crash-durability schema (docs/serving.md,
+    "Request journal"): every snapshot carries a ``journal`` field — ``None``
+    on engines running without a write-ahead journal (and on router
+    snapshots: journals are per-engine, the replica sections carry the real
+    gauges), else a dict of ``bytes_written`` / ``records_appended`` /
+    ``fsyncs`` / ``compactions`` / ``live_sessions`` / ``generation`` /
+    ``sessions_recovered`` / ``replayed_tokens``. The stream gains a
+    ``recovery`` event (sessions recovered, replayed tokens, torn-tail
+    truncation stats) emitted by ``ServingEngine.recover``. The reader
+    normalizes pre-v7 snapshots with ``None``.
 """
 
 from __future__ import annotations
@@ -77,7 +87,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v6"
+SCHEMA = "serving-metrics/v7"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -85,12 +95,14 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v4",
     "serving-metrics/v5",
     "serving-metrics/v6",
+    "serving-metrics/v7",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
 _V6_FIELDS = ("preemptions", "preempted_replays", "queue_wait_by_priority")
 _PRE_V5 = KNOWN_SCHEMAS[:4]
 _PRE_V6 = KNOWN_SCHEMAS[:5]
+_PRE_V7 = KNOWN_SCHEMAS[:6]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -170,6 +182,10 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # not 0 — "not recorded" stays distinguishable from "none"
                 for k in _V6_FIELDS:
                     snap.setdefault(k, None)
+            if schema in _PRE_V7:
+                # pre-v7 writers had no request journal; None also matches a
+                # newer engine's truthful "no journal configured"
+                snap.setdefault("journal", None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -260,6 +276,9 @@ class EngineMetrics(_JsonlMetrics):
     # priority/preemption counters (serving-metrics/v6, docs/serving.md)
     preemptions: int = 0  # running slots evicted under priority pressure
     preempted_replays: int = 0  # preempted continuations re-admitted (replay)
+    # write-ahead journal gauges (serving-metrics/v7): None <=> the engine
+    # runs without a journal and snapshots report journal: None
+    journal: Optional[Dict] = None
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
     _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -335,6 +354,23 @@ class EngineMetrics(_JsonlMetrics):
         after admissions and evictions change the free list)."""
         self.pages_total = total
         self.pages_in_use = in_use
+
+    def set_journal(self, stats: Dict) -> None:
+        """Refresh the v7 journal gauges (the engine hands in
+        ``RequestJournal.stats()`` once per tick flush — the snapshot copies
+        the latest block verbatim)."""
+        self.journal = dict(stats)
+
+    def record_recovery(self, sessions: int, replayed_tokens: int,
+                        truncated: bool, dropped_records: int,
+                        generation: int) -> None:
+        """One process-restart recovery (``ServingEngine.recover``): how many
+        live sessions were rebuilt, how many tokens their forced replays
+        carry, and whether the read hit a torn tail (with how many records
+        it dropped) — the event an operator audits after a crash."""
+        self._emit("recovery", sessions=sessions,
+                   replayed_tokens=replayed_tokens, truncated=truncated,
+                   dropped_records=dropped_records, generation=generation)
 
     def record_decode_step(self, active_slots: int, seconds: float, tokens: int) -> None:
         self.decode_steps += 1
@@ -447,6 +483,9 @@ class EngineMetrics(_JsonlMetrics):
                          if k in _PERCENTILE_KEYS}
                 for p, xs in sorted(self._queue_waits_by_priority.items())
             },
+            # v7: None without a write-ahead journal (same reading as a
+            # pre-v7 snapshot), the live gauge block otherwise
+            "journal": None if self.journal is None else dict(self.journal),
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -570,9 +609,11 @@ class RouterMetrics(_JsonlMetrics):
                 s.get("preempted_replays") or 0 for s in replicas.values()
             ),
             "queue_wait_by_priority": None,
-            # pools are per-engine: the embedded replica sections carry the
-            # real gauges, the router itself truthfully has none
+            # pools and journals are per-engine: the embedded replica
+            # sections carry the real gauges, the router itself truthfully
+            # has neither
             "page_pool": None,
+            "journal": None,
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),
             "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,

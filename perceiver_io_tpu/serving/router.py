@@ -70,7 +70,7 @@ Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v6``:
+phase tables from the single trace. Metrics are ``serving-metrics/v7``:
 router snapshots embed per-replica engine snapshots, the
 failover/shed/breaker counters, and the aggregated preemption counters
 (request ``priority`` is forwarded to engines; engine-local preemption under
@@ -80,6 +80,7 @@ page-pool pressure is docs/serving.md's "Priority classes & preemption").
 from __future__ import annotations
 
 import itertools
+import os
 import random
 import time
 from collections import deque
@@ -142,6 +143,16 @@ class RoutedRequest:
     _engine_handle: Optional[ServedRequest] = field(default=None, repr=False)
     # set once by the router's _resolve; None while the request is live
     _terminal_status: Optional[RequestStatus] = field(default=None, repr=False)
+    # (replica index, engine request id) whose JOURNAL still holds this
+    # session live after a failover: the continuation's durability anchor
+    # while it is in flight between replicas. Closed (a terminal record
+    # appended to the origin journal) exactly when the continuation becomes
+    # durable elsewhere — a successful re-dispatch journals a fresh accept —
+    # or resolves terminally while parked. Without this, a process death
+    # mid-failover would either replay the session TWICE (old accept + new
+    # accept both live) or lose a parked continuation whose origin entry was
+    # closed too early (serving/journal.py; docs/serving.md).
+    _journal_origin: Optional[tuple] = field(default=None, repr=False)
 
     @property
     def status(self) -> RequestStatus:
@@ -219,9 +230,12 @@ class _Replica:
     last_error: Optional[str] = None
     # engine request_id -> routed request, for every live hand-off
     assigned: Dict[int, RoutedRequest] = field(default_factory=dict)
-    # engine request ids failed over but not yet reclaimed from the engine
-    # (the router never touches a DOWN engine; reclaim happens at recovery)
-    orphaned: set = field(default_factory=set)
+    # engine request id -> routed request, for hand-offs failed over but not
+    # yet reclaimed from the engine (the router never touches a DOWN engine;
+    # reclaim happens at recovery). The routed request rides along so the
+    # reclaim can tell a MOVED session (journal its terminal) from one still
+    # anchored to this replica's journal (keep it live — see _journal_origin)
+    orphaned: Dict[int, RoutedRequest] = field(default_factory=dict)
     # THIS replica's own dispatch+harvest time in the current tick — the
     # slow-tick detector's input. Never measured across siblings: one wedged
     # replica must not inflate a healthy neighbor's reading
@@ -252,6 +266,7 @@ class ServingRouter:
         num_kv_pages: Optional[int] = None,
         priority_aging_ticks: Optional[int] = None,
         max_preemptions: int = 2,
+        journal: Optional[str] = None,
         telemetry=None,
         handle_preemption: bool = False,
         # failover / breaker policy (docs/reliability.md failure-domain table)
@@ -265,6 +280,9 @@ class ServingRouter:
         # SLO shedding
         shed_infeasible: bool = True,
         shed_min_samples: int = 3,
+        # internal: recover() constructs the fleet journal-less, replays each
+        # replica's journal, THEN attaches — never pass this yourself
+        _from_recovery: bool = False,
     ):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -282,6 +300,16 @@ class ServingRouter:
         self.shed_min_samples = max(shed_min_samples, 1)
         self.default_deadline_s = default_deadline_s
         self.max_queue_depth = max_queue_depth
+        # per-replica write-ahead journals (serving/journal.py): a directory
+        # TEMPLATE with an "{i}" placeholder, one journal per engine —
+        # request ids are engine-local, so replicas sharing one directory
+        # would collide. ServingRouter.recover reads the same template back.
+        if journal is not None and num_replicas > 1 and "{i}" not in journal:
+            raise ValueError(
+                "journal must be a per-replica template containing '{i}' "
+                f"with num_replicas > 1, got {journal!r}"
+            )
+        self._journal_template = journal
         # cooldown ladder: reliability/retry.py's bounded-exponential schedule
         # in TICK units with jitter 0 — cooldown(nth consecutive open) =
         # min(max, base * 2^(n-1)) ticks. Deterministic: the rng argument is
@@ -325,6 +353,12 @@ class ServingRouter:
                     # the template keeps the streams separate per replica
                     metrics_jsonl=replica_metrics_jsonl.format(i=i)
                     if replica_metrics_jsonl else None,
+                    # per-replica crash-durable journal (same "{i}" template
+                    # discipline as the metrics streams); _from_recovery
+                    # leaves engines journal-less so recover() can replay the
+                    # existing directories before attaching them
+                    journal=journal.format(i=i)
+                    if journal and not _from_recovery else None,
                     telemetry=engine_telemetry,
                     obs_ns=f"serving.r{i}",
                 ),
@@ -350,6 +384,102 @@ class ServingRouter:
             self._preempt_handler, self._preempt_previous = (
                 install_preemption_handler(_request_preempt)
             )
+
+    # ---------------------------------------------------------------- recovery
+    @classmethod
+    def recover(cls, model, params, journal: str, num_replicas: int = 2,
+                fsync: str = "accept", segment_max_records: int = 4096,
+                **router_kwargs):
+        """Rebuild a router fleet from per-replica write-ahead journals after
+        process death (docs/serving.md "Request journal"). ``journal`` is
+        the same ``"{i}"`` directory template the dead process ran with;
+        each replica's journal is replayed into ITS OWN replica (placement
+        preserved — per-directory recovery keeps the swap atomic per
+        journal, so a crash mid-recovery re-recovers cleanly: already-swapped
+        replicas hold their sessions in their new generation, untouched ones
+        still hold the old one). Returns ``(router, info)`` with
+        ``info["handles"]`` the recovered ``RoutedRequest`` handles (replica
+        order, accept order within a replica); run the router as usual and
+        every recovered session completes f64 token-identical to an
+        uninterrupted run. Recovered in-flight sessions resume as
+        ``PREEMPTED`` continuations that ``drain()`` finishes; recovered
+        never-admitted backlog rejects as ``draining`` — the engine drain
+        contract, fleet-wide."""
+        if num_replicas > 1 and "{i}" not in journal:
+            raise ValueError(
+                "journal must be a per-replica template containing '{i}' "
+                f"with num_replicas > 1, got {journal!r}"
+            )
+        # accepted ⇒ durable cuts both ways: a journal directory on disk
+        # BEYOND num_replicas holds accepted sessions this recovery would
+        # silently never read (the dead fleet ran more replicas than the
+        # caller asked to rebuild — e.g. relying on the signature default).
+        # Probe a bounded index range past num_replicas and fail loudly.
+        if "{i}" in journal:
+            from perceiver_io_tpu.serving.journal import read_journal as _read
+
+            # live sessions, not raw records: a fully DRAINED stray journal
+            # (every session terminal) has nothing this recovery could drop,
+            # and blocking on it would strand a legitimately down-sized fleet
+            stray = [
+                i for i in range(num_replicas, num_replicas + 64)
+                if os.path.isdir(journal.format(i=i))
+                and len(_read(journal.format(i=i)).sessions) > 0
+            ]
+            if stray:
+                raise ValueError(
+                    f"journal template {journal!r} holds live (non-terminal) "
+                    f"sessions for replica indices {stray} beyond "
+                    f"num_replicas={num_replicas} — recovering fewer "
+                    f"replicas than the dead fleet ran would silently drop "
+                    f"their accepted sessions (pass the fleet's real "
+                    f"num_replicas)"
+                )
+        router = cls(model, params, num_replicas=num_replicas,
+                     journal=journal, _from_recovery=True, **router_kwargs)
+        now = time.perf_counter()
+        handles: List[RoutedRequest] = []
+        per_replica: Dict[str, Dict] = {}
+        for r in router.replicas:
+            info = r.engine._recover_attach(
+                journal.format(i=r.rid), fsync=fsync,
+                segment_max_records=segment_max_records,
+            )
+            for handle in info.pop("handles"):
+                routed = RoutedRequest(
+                    request_id=next(router._ids),
+                    prompt_ids=handle.prompt_ids,
+                    config=handle.config,
+                    rng=handle.rng,
+                    priority=handle.priority,
+                    submitted_at=now,
+                    deadline_s=handle.deadline_s,
+                )
+                routed._engine_handle = handle
+                routed.replica = r.rid
+                r.assigned[handle.request_id] = routed
+                if routed.deadline_s is not None:
+                    router._deadlines_seen = True
+                # the recovered request re-enters the router's books as a
+                # fresh submit+dispatch pair so the lifetime accounting
+                # (submitted == finished + rejected + ...) stays closed
+                router.metrics.record_submit(routed.request_id,
+                                             int(handle.prompt_ids.size),
+                                             priority=routed.priority)
+                router.metrics.record_dispatch(routed.request_id, r.rid,
+                                               load=r.engine.load)
+                if router._obs_on:
+                    router._obs.async_begin("router.request", routed.request_id,
+                                            prompt_len=int(handle.prompt_ids.size))
+                handles.append(routed)
+            per_replica[f"r{r.rid}"] = info
+        return router, {
+            "sessions": len(handles),
+            "replayed_tokens": sum(i["replayed_tokens"]
+                                   for i in per_replica.values()),
+            "replicas": per_replica,
+            "handles": handles,
+        }
 
     # ------------------------------------------------------------------ submit
     def submit(
@@ -463,14 +593,32 @@ class ServingRouter:
         now = time.perf_counter()
         saw_closed = False
         for r in self._serving_replicas():
+            if r.breaker != BREAKER_CLOSED:
+                continue  # opened mid-scan by a dispatch-failure cascade
             saw_closed = True
             load_at_decision = r.engine.load  # submit() bumps it
-            handle = r.engine.submit(
-                routed.prompt_ids, config=routed.config, rng=routed.rng,
-                deadline_s=self._remaining_deadline(routed, now),
-                replay_ids=emitted if emitted else None,
-                priority=routed.priority,
-            )
+            try:
+                handle = r.engine.submit(
+                    routed.prompt_ids, config=routed.config, rng=routed.rng,
+                    deadline_s=self._remaining_deadline(routed, now),
+                    replay_ids=emitted if emitted else None,
+                    priority=routed.priority,
+                )
+            except BaseException as exc:  # noqa: BLE001
+                # a dispatch-path failure — a journal append dying on real
+                # ENOSPC/EIO, or a fail-stopped journal refusing appends —
+                # is a REPLICA fault, not a router fault: the engine already
+                # closed the request's own accounting (REJECTED /
+                # journal_error), so contain it exactly like a tick
+                # exception (breaker strike; at the threshold the replica
+                # opens and its live work fails over) and keep trying THIS
+                # request on the remaining healthy replicas. Letting it
+                # propagate would crash the whole fleet on one replica's
+                # disk fault — the opposite of the router's isolation
+                # contract. Router-side validation already ran, so this is
+                # never a malformed-input error the caller needs to see.
+                self._on_tick_failure(r, exc)
+                continue
             if handle.status is RequestStatus.REJECTED:
                 if handle.finish_reason == "queue_full":
                     continue  # backpressure at this replica: try the next
@@ -483,6 +631,10 @@ class ServingRouter:
             # max(salvage, engine stream), so the view stays monotonic while
             # the engine re-emits the replayed prefix
             r.assigned[handle.request_id] = routed
+            # the new replica's journal now holds the continuation (fresh
+            # accept, replay prefix included): close the failover origin's
+            # live entry so a later fleet recovery replays the session ONCE
+            self._journal_note_moved(routed)
             self.metrics.record_dispatch(routed.request_id, r.rid,
                                          load=load_at_decision)
             if self._obs_on:
@@ -503,7 +655,11 @@ class ServingRouter:
             return True
         # no healthy replica at all: park until a breaker closes (the
         # bound, when configured, still applies — an outage must not
-        # grow an unbounded router backlog)
+        # grow an unbounded router backlog). A FRESH submit parked here has
+        # never reached an engine, so on a journaled fleet it is memory-only
+        # until dispatched — the documented durability boundary
+        # (docs/serving.md "Fleet durability boundary"); failover
+        # continuations stay durable via their origin journal entry.
         if self.max_queue_depth is not None and len(self._pending) >= self.max_queue_depth:
             self._resolve(routed, RequestStatus.REJECTED, "queue_full")
             return True
@@ -532,6 +688,31 @@ class ServingRouter:
             else:
                 kept.append(routed)
         self._pending = kept
+
+    def _journal_note_moved(self, routed: RoutedRequest,
+                            status: str = "failed",
+                            reason: str = "replica_failover") -> None:
+        """Close a failed-over session's entry in its ORIGIN replica's
+        journal, once the continuation is durable elsewhere (a successful
+        re-dispatch journaled a fresh accept) or terminal (resolved while
+        parked). Until this runs, the origin journal deliberately keeps the
+        session LIVE — it is the continuation's only durable copy while
+        parked — and a fleet recovery would resume it there. Best-effort: a
+        broken origin journal must not break dispatch (worst case one
+        superseded replay candidate survives to the next recovery, where the
+        duplicate is visible, not silent)."""
+        origin = routed._journal_origin
+        if origin is None:
+            return
+        routed._journal_origin = None
+        replica_idx, engine_rid = origin
+        journal = self.replicas[replica_idx].engine.journal
+        if journal is None or journal.failed or not journal.tracks(engine_rid):
+            return
+        try:
+            journal.append_tick([], {}, [(engine_rid, status, reason)])
+        except Exception:  # noqa: BLE001 — durability bookkeeping, not control flow
+            pass
 
     # ----------------------------------------------------------------- breaker
     def _transition(self, r: _Replica, new: str) -> None:
@@ -576,10 +757,17 @@ class ServingRouter:
                 # (_recover_replica): their release touches device state we
                 # only trust after a healthy tick.
                 for engine_req_id in sorted(r.orphaned):
+                    routed = r.orphaned[engine_req_id]
+                    # a PARKED continuation's origin entry is its only
+                    # durable copy: reclaiming the stale engine bookkeeping
+                    # must not journal a terminal until the continuation
+                    # lands elsewhere (_journal_note_moved closes it then)
+                    anchored = routed._journal_origin == (r.rid, engine_req_id)
                     if r.engine.evict_request(engine_req_id, "replica_failover",
                                               status=RequestStatus.FAILED,
-                                              queued_only=True):
-                        r.orphaned.discard(engine_req_id)
+                                              queued_only=True,
+                                              journal_terminal=not anchored):
+                        r.orphaned.pop(engine_req_id)
 
     def _on_tick_failure(self, r: _Replica, exc: BaseException) -> None:
         r.consecutive_failures += 1
@@ -627,9 +815,13 @@ class ServingRouter:
         backoff ladder resets: a recovered replica earns the base cooldown
         again."""
         r.engine.discard_pending_harvest()
-        for engine_req_id in sorted(r.orphaned):
+        for engine_req_id, routed in sorted(r.orphaned.items()):
+            # same anchoring rule as _promote_breakers: a still-parked
+            # continuation's session must stay LIVE in this journal
+            anchored = routed._journal_origin == (r.rid, engine_req_id)
             r.engine.evict_request(engine_req_id, "replica_failover",
-                                   status=RequestStatus.FAILED)
+                                   status=RequestStatus.FAILED,
+                                   journal_terminal=not anchored)
         r.orphaned.clear()
         # drop the orphaned terminal handles (and any pre-crash finished ones
         # whose routed requests were failed over): nothing maps to them now
@@ -654,7 +846,16 @@ class ServingRouter:
                 # between evict and harvest): the outcome stands
                 self._resolve(routed, handle.status, handle.finish_reason)
                 continue
-            r.orphaned.add(engine_req_id)
+            r.orphaned[engine_req_id] = routed
+            if (
+                r.engine.journal is not None
+                and r.engine.journal.tracks(engine_req_id)
+            ):
+                # the lost replica's journal keeps this session LIVE until
+                # the continuation is durable elsewhere or terminal — see
+                # _journal_note_moved. Set BEFORE the dispatch below, which
+                # closes it on a successful hand-off.
+                routed._journal_origin = (r.rid, engine_req_id)
             # keep the LONGEST prefix seen: a crash mid-replay hands back a
             # handle shorter than the salvage it was rebuilding
             salvaged = list(handle.output_ids) if handle is not None else []
@@ -713,6 +914,12 @@ class ServingRouter:
         """The ONE terminal-bookkeeping path: submit-time refusals, dispatch
         rejections, harvest outcomes, failover exhaustion, and drain all land
         here, so counters, JSONL, and trace spans can never diverge."""
+        # a parked continuation resolving terminally (TTL expiry, drain,
+        # max_failovers) must close its failover origin's journal entry with
+        # the real outcome, or a later fleet recovery would resurrect a
+        # request the caller already saw go terminal
+        self._journal_note_moved(routed, status=status.value,
+                                 reason=reason or "resolved")
         routed._terminal_status = status
         routed.finish_reason = reason
         routed.finished_at = time.perf_counter()
@@ -858,7 +1065,7 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v6 router snapshot with per-replica sections."""
+        """serving-metrics/v7 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:

@@ -130,6 +130,18 @@ class SlotScheduler(Generic[T]):
         admissions against the free page count (serving/engine.py)."""
         return (e.request for e in self._ordered())
 
+    def queue_snapshot(self) -> List[Tuple[T, int, int]]:
+        """(request, effective priority, seq) triples in admission order — a
+        read-only view of the whole ordering decision. Journal recovery pins
+        its seniority contract through this (tests/test_journal.py): a
+        rebuilt queue must rank recovered sessions exactly as the dead
+        process ranked the originals, and asserting on the (priority, seq)
+        keys catches an ordering regression the eventual token outputs might
+        mask (same tokens can emerge from a different admission order when
+        slots are plentiful)."""
+        return [(e.request, self.effective_priority(e), e.seq)
+                for e in self._ordered()]
+
     # ------------------------------------------------------------------ policy
     def advance_tick(self) -> None:
         """Advance the aging clock (one call per engine tick). A no-op cost

@@ -34,6 +34,7 @@ import orbax.checkpoint as ocp
 
 from perceiver_io_tpu.reliability import faults
 from perceiver_io_tpu.reliability.retry import RetryPolicy, retry_call
+from perceiver_io_tpu.utils import fsync_dir
 
 MANIFEST_SCHEMA = "ckpt-manifest/v1"
 
@@ -48,15 +49,25 @@ def _checkpointer() -> ocp.StandardCheckpointer:
 
 
 def atomic_write_json(path: str, payload: Any, indent: Optional[int] = None) -> None:
-    """Write JSON via tmp + rename so a kill mid-write can never leave a
-    corrupt file — the one audited code path for every sidecar artifact
-    (iterator snapshots, best-metric records, manifests, bench outputs)."""
+    """Write JSON via tmp + fsync + rename + parent-directory fsync so a kill
+    OR a power loss mid-write can never leave a corrupt or vanished file —
+    the one audited code path for every sidecar artifact (iterator
+    snapshots, best-metric records, manifests, bench outputs). The file
+    fsync makes the BYTES durable before the rename exposes them (an
+    un-fsynced rename can commit the name to an empty file); the directory
+    fsync makes the NAME durable (rename is atomic against process death,
+    but the new directory entry can still be rolled back by a power loss
+    until the parent directory's metadata is synced — the gap the
+    docs/reliability.md kill-point analysis previously missed)."""
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=indent)
         if indent is not None:
             f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
 
 
 def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
@@ -254,6 +265,12 @@ def rotate_previous(path: str, aux_paths: Tuple[str, ...] = ()) -> bool:
     for src, dst in renames:
         if os.path.exists(src):
             os.replace(src, dst)
+    # one directory fsync covers the whole rotation batch: without it a
+    # power loss can roll back any subset of the renames above — including
+    # the data-directory move — leaving states the kill-point analysis
+    # (docs/reliability.md) assumed impossible. Process death alone never
+    # needed this (renames land in the dirent cache); power loss does.
+    fsync_dir(parent or ".")
     return True
 
 

@@ -7,6 +7,32 @@ from contextlib import contextmanager
 from typing import Iterator, Optional
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename/create inside it survives power loss.
+
+    ``os.replace`` makes the swap atomic against crashes of the writing
+    process, but the new directory entry itself lives in the parent
+    directory's metadata — without a directory fsync a power loss after the
+    rename can roll the entry back and the "atomically committed" file
+    vanishes (the classic rename-without-dir-fsync gap; see
+    docs/reliability.md). Callers: ``atomic_write_json`` / checkpoint
+    lineage rotation (training/checkpoint.py) and the request-journal
+    generation swap (serving/journal.py). Best-effort on platforms whose
+    directories cannot be opened or fsynced (EINVAL on some filesystems):
+    those systems never offered the guarantee, so failing loudly would only
+    break them for zero durability gain."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 @contextmanager
 def env_override(key: str, value: Optional[str]) -> Iterator[None]:
     """Temporarily set (or, with ``value=None``, unset) one env var,

@@ -43,6 +43,20 @@ exercised on every change, not just when production finds them:
                            the same priority-bearing workload bit-identical
                            to the pre-priority FIFO engine (plain queue_full
                            backpressure, zero preemptions)
+  * ``journal_crash_restart`` a REAL child serving process SIGKILLed
+                           mid-tick; a fresh process recovers every accepted
+                           request from the write-ahead journal, f64
+                           token-identical (greedy + sampled) to an
+                           uninterrupted run, zero extra compiled programs
+                           (scripts/journal_crash_harness.py)
+  * ``journal_torn_tail``  a power loss mid-append leaves a half-written
+                           journal record; recovery truncates at the torn
+                           record, reports it, and replays everything before
+                           it f64-identical
+  * ``journal_compaction_crash`` a kill at either stage of a journal
+                           compaction (before/after the atomic generation
+                           rename) loses nothing — whichever generation is
+                           durable recovers identically
 
 Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
 
@@ -545,6 +559,190 @@ def check_preempt_disabled_inert() -> dict:
     }
 
 
+def check_journal_crash_restart() -> dict:
+    """Process death is survivable (docs/serving.md "Request journal"): a
+    REAL child serving process is SIGKILLed mid-tick and a fresh process
+    recovers from the write-ahead journal — every accepted request (greedy
+    AND sampled) completes with output f64 token-identical to an
+    uninterrupted run, and replay compiles zero programs beyond the standard
+    set. Run twice into fresh directories: the recovered outputs are pinned
+    to the same deterministic reference both times, whatever tick the kill
+    actually landed on."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "journal_crash_harness",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "journal_crash_harness.py"),
+    )
+    harness = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(harness)
+
+    runs, shared = [], None
+    # the harness enables x64 (its reference/recovery math is f64); the
+    # context restores the flag so later scenarios see their own default
+    with _x64():
+        for _ in range(2):
+            d = tempfile.mkdtemp(prefix="chaos-journal-crash-")
+            try:
+                result = harness.run_crash_restart(d, shared=shared)
+                shared = result.pop("_shared")  # reuse the deterministic reference
+                runs.append(result)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+    return {
+        "ok": all(r["ok"] for r in runs),
+        "runs": [
+            {k: r[k] for k in ("sessions_recovered", "outputs_identical",
+                               "all_finished", "decode_compilations",
+                               "ticks_at_kill")}
+            for r in runs
+        ],
+    }
+
+
+def check_journal_torn_tail() -> dict:
+    """A power loss mid-append leaves a half-written record at the journal's
+    tail (injected via ``serving.journal.torn_write``): recovery TRUNCATES at
+    the torn record — everything before it (all fully-accepted requests)
+    recovers f64 token-identical to an uninterrupted run, the torn accept is
+    reported (truncated flag + dropped count), and repeat runs are
+    identical."""
+    from perceiver_io_tpu.serving import JournalTornWrite, ServingEngine
+
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        ref = _greedy_tokens(_engine(model, params, num_slots=2), prompts)
+        expected = [h.result().tolist() for h in ref]
+
+        def run():
+            d = tempfile.mkdtemp(prefix="chaos-journal-torn-")
+            try:
+                engine = _engine(model, params, num_slots=2,
+                                 journal=os.path.join(d, "j"))
+                handles = [engine.submit(p, max_new_tokens=5) for p in prompts]
+                for _ in range(2):
+                    engine.step()
+                torn = False
+                with armed("serving.journal.torn_write", times=1):
+                    try:
+                        engine.submit([50, 51], max_new_tokens=5)
+                    except JournalTornWrite:
+                        torn = True  # the "process dies mid-append" moment
+                # the engine object is ABANDONED here (no close — a dead
+                # process flushes nothing further); recover from disk
+                engine2, info = ServingEngine.recover(
+                    model, params, os.path.join(d, "j"), num_slots=2)
+                engine2.run_until_drained(max_steps=300)
+                outs = [h.result().tolist() for h in info["handles"]]
+                return {
+                    "torn": torn,
+                    "sessions": info["sessions"],
+                    "truncated": info["truncated"],
+                    "dropped": info["dropped_records"],
+                    "outputs": outs,
+                    "statuses": [h.status.value for h in info["handles"]],
+                }
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        r1, r2 = run(), run()
+    return {
+        "ok": (
+            r1["torn"]
+            and r1["sessions"] == len(prompts)  # the torn 4th accept is gone
+            and r1["truncated"] and r1["dropped"] >= 1
+            and r1["outputs"] == expected
+            and r1["statuses"] == ["finished"] * len(prompts)
+            and r1 == r2
+        ),
+        "truncated_reported": r1["truncated"],
+        "recovered_sessions": r1["sessions"],
+        "outputs_identical": r1["outputs"] == expected,
+        "deterministic_repeat": r1 == r2,
+    }
+
+
+def check_journal_compaction_crash() -> dict:
+    """A kill at either stage of a journal compaction (before the atomic
+    generation rename, or after it but before old-generation deletion —
+    ``serving.journal.compact.kill`` slot 0/1) loses nothing: recovery reads
+    whichever generation is the durable truth and every live session
+    completes f64 token-identical to an uncontended run; repeat runs are
+    identical per stage."""
+    from perceiver_io_tpu.reliability.faults import KilledMidWrite
+    from perceiver_io_tpu.serving import ServingEngine
+
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        # enough requests that several are terminal (compaction has records
+        # to drop) while the last ones are still live at the crash
+        prompts = [[i + 1, i + 2] for i in range(6)]
+        ref = _greedy_tokens(_engine(model, params, num_slots=2), prompts, max_new=3)
+        expected = [h.result().tolist() for h in ref]
+
+        def run(stage):
+            d = tempfile.mkdtemp(prefix="chaos-journal-compact-")
+            try:
+                from perceiver_io_tpu.serving import RequestJournal
+
+                # tiny segments: the rotation check trips mid-run, and with
+                # terminal requests accumulated it COMPACTS — where the kill
+                # is armed
+                journal = RequestJournal(os.path.join(d, "j"),
+                                         segment_max_records=6)
+                engine = _engine(model, params, num_slots=2, journal=journal)
+                handles = [engine.submit(p, max_new_tokens=3) for p in prompts]
+                killed = False
+                with armed("serving.journal.compact.kill", slot=stage, times=1):
+                    try:
+                        engine.run_until_drained(max_steps=300)
+                    except KilledMidWrite:
+                        killed = True
+                # abandoned mid-compaction; a fresh process recovers
+                engine2, info = ServingEngine.recover(
+                    model, params, os.path.join(d, "j"), num_slots=2)
+                engine2.run_until_drained(max_steps=300)
+                finished = {tuple(h.prompt_ids.tolist()): h.result().tolist()
+                            for h in info["handles"]}
+                # completed-before-crash requests are terminal in the journal
+                # and rightly NOT recovered; every recovered one must match
+                # the reference for its prompt
+                identical = all(
+                    finished[tuple(p)] == want
+                    for p, want in zip(prompts, expected)
+                    if tuple(p) in finished
+                )
+                return {"killed": killed, "sessions": info["sessions"],
+                        "identical": identical,
+                        "statuses": [h.status.value for h in info["handles"]],
+                        "finished": sorted(finished)}
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        results = {}
+        for stage in (0, 1):
+            r1, r2 = run(stage), run(stage)
+            results[stage] = {
+                "r": r1,
+                "repeat_identical": r1 == r2,
+            }
+    return {
+        "ok": all(
+            res["r"]["killed"]
+            and res["r"]["identical"]
+            and all(s == "finished" for s in res["r"]["statuses"])
+            and res["repeat_identical"]
+            for res in results.values()
+        ),
+        "pre_rename": results[0]["r"],
+        "post_rename": results[1]["r"],
+        "deterministic_repeat": all(res["repeat_identical"]
+                                    for res in results.values()),
+    }
+
+
 def check_router_crash_failover() -> dict:
     """A replica crashed mid-decode loses nothing: the victim finishes
     token-identical (f64) to the fault-free run after failover, the survivor
@@ -704,6 +902,9 @@ CHECKS = {
     "paging_pool_exhaustion": check_paging_pool_exhaustion,
     "preempt_storm": check_preempt_storm,
     "preempt_disabled_inert": check_preempt_disabled_inert,
+    "journal_crash_restart": check_journal_crash_restart,
+    "journal_torn_tail": check_journal_torn_tail,
+    "journal_compaction_crash": check_journal_compaction_crash,
     "router_crash_failover": check_router_crash_failover,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,

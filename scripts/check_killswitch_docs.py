@@ -1,19 +1,25 @@
 """Docs drift guard: every ``PERCEIVER_IO_TPU_*`` env var the package reads
-must appear in the documentation (docs/*.md or README.md).
+must appear in the documentation (docs/*.md or README.md), and the newest
+artifact-schema version the package WRITES must be the one the docs
+describe.
 
 The repo's contract is that every kill-switch and env knob is discoverable
 from the docs kill-switch tables (docs/serving.md, docs/training-pipeline.md,
-docs/reliability.md, docs/observability.md). Nothing enforces that at review
-time, so vars drift: a switch added in code but not documented is an
-operator trap — the rollback lever exists and nobody can find it. This
-script greps the package for env-var references and fails when any is
-missing from the docs; it runs in the fast tier as a pytest smoke
-(tests/test_killswitch_docs.py), so the drift is caught on every change.
+docs/reliability.md, docs/observability.md), and that docs/serving.md's
+metrics-schema section tracks the ``serving-metrics/v*`` version the engine
+actually stamps on snapshots. Nothing enforces either at review time, so
+they drift: a switch added in code but not documented is an operator trap
+(the rollback lever exists and nobody can find it), and a schema bumped in
+code but not in the docs is a reader trap (the v4→v5→v6 bumps each raced
+their doc update through review). This script greps the package for env-var
+references and schema literals and fails when the docs lag; it runs in the
+fast tier as a pytest smoke (tests/test_killswitch_docs.py), so the drift is
+caught on every change.
 
 Pure stdlib and jax-free — runs anywhere the repo is.
 
 Usage: ``python scripts/check_killswitch_docs.py [--json]``; exit 1 when any
-var is undocumented.
+var is undocumented or the documented schema version lags the package.
 """
 
 from __future__ import annotations
@@ -30,6 +36,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # a var reference is the prefix plus at least one more identifier char, so a
 # bare "PERCEIVER_IO_TPU_*" glob in prose never counts as a variable
 ENV_VAR_RE = re.compile(r"PERCEIVER_IO_TPU_[A-Z0-9][A-Z0-9_]*")
+
+# versioned artifact-schema literals whose docs must track the package's
+# newest version. Each entry: (regex capturing the version int, the doc file
+# that owns the schema section). Extend here when a new versioned schema
+# family appears.
+SCHEMA_FAMILIES = {
+    "serving-metrics": (re.compile(r"serving-metrics/v(\d+)"), "docs/serving.md"),
+}
 
 
 def _scan(paths: List[str]) -> Set[str]:
@@ -61,10 +75,52 @@ def documented_env_vars(repo: str = _REPO) -> Set[str]:
     return _scan(paths)
 
 
+def _package_py_files(repo: str) -> List[str]:
+    paths = []
+    for root, _dirs, files in os.walk(os.path.join(repo, "perceiver_io_tpu")):
+        paths.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
+    return sorted(paths)
+
+
+def _scan_versions(regex, paths: List[str]) -> Set[int]:
+    versions: Set[int] = set()
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                versions.update(int(v) for v in regex.findall(f.read()))
+        except OSError:
+            continue
+    return versions
+
+
+def check_schema_versions(repo: str = _REPO) -> Dict:
+    """For each versioned schema family: the MAX version the package
+    references must appear in the family's owning doc file. Older versions
+    may legitimately linger in both (readers stay version-tolerant); only a
+    doc that has never heard of the newest version fails — exactly the
+    v4→v5→v6 doc race this guard would have caught."""
+    out: Dict[str, Dict] = {}
+    pkg_files = _package_py_files(repo)
+    for family, (regex, doc_rel) in SCHEMA_FAMILIES.items():
+        in_package = _scan_versions(regex, pkg_files)
+        doc_path = os.path.join(repo, *doc_rel.split("/"))
+        in_doc = _scan_versions(regex, [doc_path])
+        newest = max(in_package) if in_package else None
+        out[family] = {
+            "doc": doc_rel,
+            "package_versions": sorted(in_package),
+            "documented_versions": sorted(in_doc),
+            "newest_package_version": newest,
+            "ok": newest is None or newest in in_doc,
+        }
+    return out
+
+
 def check(repo: str = _REPO) -> Dict:
     in_package = package_env_vars(repo)
     in_docs = documented_env_vars(repo)
     missing = sorted(in_package - in_docs)
+    schemas = check_schema_versions(repo)
     return {
         "package_vars": sorted(in_package),
         "documented_vars": sorted(in_docs),
@@ -73,7 +129,8 @@ def check(repo: str = _REPO) -> Dict:
         # legitimately describe a var slightly ahead of or behind a rename,
         # and prose examples (e.g. PERCEIVER_IO_TPU_FAULT specs) are fine
         "documented_but_unused": sorted(in_docs - in_package),
-        "ok": not missing,
+        "schemas": schemas,
+        "ok": not missing and all(s["ok"] for s in schemas.values()),
     }
 
 
@@ -97,6 +154,15 @@ def main(argv=None) -> Dict:
             print("documented but not referenced by the package (informational):")
             for var in result["documented_but_unused"]:
                 print(f"  - {var}")
+        for family, s in result["schemas"].items():
+            if s["ok"]:
+                print(f"schema {family}: package v{s['newest_package_version']} "
+                      f"documented in {s['doc']}")
+            else:
+                print(f"SCHEMA DRIFT: {family} is at "
+                      f"v{s['newest_package_version']} in the package but "
+                      f"{s['doc']} documents only "
+                      f"{s['documented_versions']} — update the schema table")
     if not result["ok"] and __name__ == "__main__":
         raise SystemExit(1)
     return result

@@ -1,0 +1,255 @@
+"""Real process-death proof for the serving request journal: a child serving
+process is SIGKILLed mid-tick and a fresh process recovers every accepted
+request, token-identical to an uninterrupted run.
+
+The in-process journal tests (tests/test_journal.py) simulate death by
+abandoning an engine object; this harness removes the simulation: the child
+is a separate Python process with its own jax runtime, the kill is a real
+``SIGKILL`` (no atexit, no flush, no destructor runs — exactly what OOM or a
+host reboot leaves behind), and recovery happens in a process that shares
+nothing with the victim but the journal directory. ``scripts/chaos_check.py``
+drives this as the ``journal_crash_restart`` scenario; it is also runnable
+by hand:
+
+    JAX_PLATFORMS=cpu python scripts/journal_crash_harness.py --workdir /tmp/jd
+
+Protocol: the child (``serve`` mode) builds the deterministic tiny f64 model,
+submits the fixed workload (greedy + sampled, fixed rng keys) into a
+journaled engine, then ticks slowly (a short sleep per tick widens the
+parent's kill window), writing an atomic progress file each tick. The parent
+waits until the accepts are durable and a few ticks have run, SIGKILLs the
+child, recovers with ``ServingEngine.recover``, and checks the contract:
+every accepted request FINISHED, outputs f64 token-identical to the
+uninterrupted reference (computed in the parent from the same seeds), and
+zero compiled programs beyond the standard set (decode = 1). The assertions
+hold for ANY kill point after acceptance — the scenario's determinism does
+not depend on catching the child at an exact tick.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# fixed workload: (prompt, max_new_tokens, do_sample, rng seed). Sampled
+# requests included deliberately — recovery must reproduce the rng CHAIN,
+# not just argmax. max_new is large enough that nothing finishes before the
+# parent's kill lands (the per-tick sleep gives it ~TICK_SLEEP_S slack per
+# tick), so "every accepted request completes" is checked for ALL of them.
+WORKLOAD = (
+    ([1, 2, 3], 8, False, 0),
+    ([4, 5], 8, True, 7),
+    ([6, 7, 8, 9], 8, False, 3),
+)
+NUM_SLOTS = 2
+TICK_SLEEP_S = 0.05
+
+
+def build_model():
+    """The chaos-suite tiny model in float64 with a fixed init seed — parent
+    (reference + recovery) and child (victim) must build bit-identical
+    params."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+    from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+
+    config = CausalSequenceModelConfig(
+        vocab_size=60, max_seq_len=12, max_latents=6, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config, param_dtype=jnp.float64)
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(model.init, static_argnames="prefix_len")(
+        rng, jax.random.randint(rng, (1, 8), 0, 60), prefix_len=2
+    )
+    return model, params
+
+
+def _submit_workload(engine):
+    import jax
+
+    return [
+        engine.submit(prompt, max_new_tokens=max_new, do_sample=sample,
+                      temperature=0.9 if sample else 1.0,
+                      rng=jax.random.PRNGKey(seed))
+        for prompt, max_new, sample, seed in WORKLOAD
+    ]
+
+
+def reference_outputs(model, params):
+    """The uninterrupted run every recovery is pinned against."""
+    from perceiver_io_tpu.serving import ServingEngine
+
+    engine = ServingEngine(model, params, num_slots=NUM_SLOTS)
+    handles = _submit_workload(engine)
+    engine.run_until_drained(max_steps=300)
+    assert all(h.ok for h in handles)
+    return [h.result().tolist() for h in handles]
+
+
+def _write_progress(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def serve(journal_dir: str, progress: str) -> None:
+    """Child mode: journaled serving loop, slow-ticked, killed externally."""
+    model, params = build_model()
+    from perceiver_io_tpu.serving import ServingEngine
+
+    engine = ServingEngine(model, params, num_slots=NUM_SLOTS,
+                           journal=journal_dir)
+    handles = _submit_workload(engine)
+    _write_progress(progress, {"accepted": len(handles), "ticks": 0})
+    ticks = 0
+    while engine.step():
+        ticks += 1
+        _write_progress(progress, {"accepted": len(handles), "ticks": ticks})
+        time.sleep(TICK_SLEEP_S)  # the parent's kill window
+    engine.close()
+    _write_progress(progress, {"accepted": len(handles), "ticks": ticks,
+                               "done": True,
+                               "results": [h.result().tolist() for h in handles]})
+
+
+def spawn_and_kill(journal_dir: str, progress: str,
+                   kill_after_ticks: int = 2, timeout_s: float = 120.0) -> dict:
+    """Run a child serving process and SIGKILL it once it has accepted the
+    workload and decoded ``kill_after_ticks`` ticks. Returns what the parent
+    observed (ticks at kill, whether the child finished early — callers
+    treat early completion as a failed kill window)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if os.path.exists(progress):
+        os.remove(progress)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "serve",
+         "--journal-dir", journal_dir, "--progress", progress],
+        env=env, cwd=_REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + timeout_s
+    seen: dict = {}
+    try:
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                stderr = child.stderr.read().decode(errors="replace")
+                raise RuntimeError(
+                    f"serving child exited (rc={child.returncode}) before the "
+                    f"kill landed: {stderr[-2000:]}"
+                )
+            if os.path.exists(progress):
+                try:
+                    with open(progress) as f:
+                        seen = json.load(f)
+                except (OSError, ValueError):
+                    seen = {}  # racing the atomic replace: retry next poll
+                if seen.get("ticks", -1) >= kill_after_ticks:
+                    break
+            time.sleep(0.01)
+        else:
+            raise RuntimeError(
+                f"serving child never reached tick {kill_after_ticks} "
+                f"within {timeout_s}s (progress: {seen})"
+            )
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+        child.stderr.close()
+    return {"ticks_at_kill": seen.get("ticks"), "accepted": seen.get("accepted")}
+
+
+def run_crash_restart(workdir: str, kill_after_ticks: int = 2,
+                      shared=None) -> dict:
+    """The full proof, parent side: reference run → child killed mid-tick →
+    recovery → identity + compile-count checks. Returns a result dict (the
+    chaos scenario embeds it). ``shared`` (a ``(model, params, expected)``
+    triple from a previous run) skips rebuilding the deterministic reference
+    when a caller repeats the scenario."""
+    model, params, expected = shared if shared is not None else (None,) * 3
+    if model is None:
+        model, params = build_model()
+    if expected is None:
+        expected = reference_outputs(model, params)
+    journal_dir = os.path.join(workdir, "journal")
+    progress = os.path.join(workdir, "progress.json")
+    kill_info = spawn_and_kill(journal_dir, progress,
+                               kill_after_ticks=kill_after_ticks)
+
+    from perceiver_io_tpu.serving import ServingEngine
+
+    engine, info = ServingEngine.recover(model, params, journal_dir,
+                                         num_slots=NUM_SLOTS)
+    engine.run_until_drained(max_steps=300)
+    handles = info["handles"]
+    outputs = [h.result().tolist() for h in handles]
+    result = {
+        "sessions_recovered": info["sessions"],
+        "expected_sessions": len(WORKLOAD),
+        "replayed_tokens": info["replayed_tokens"],
+        "ticks_at_kill": kill_info["ticks_at_kill"],
+        "all_finished": all(h.ok for h in handles),
+        "outputs_identical": outputs == expected,
+        "decode_compilations": engine.decode_compilations,
+        "prefill_compilations": engine.prefill_compilations,
+        "ok": (
+            info["sessions"] == len(WORKLOAD)
+            and all(h.ok for h in handles)
+            and outputs == expected
+            and engine.decode_compilations == 1
+        ),
+        "_shared": (model, params, expected),
+    }
+    engine.close()
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("mode", nargs="?", default="proof",
+                    choices=("proof", "serve"),
+                    help="proof = full parent-side kill/restart run; "
+                         "serve = internal child mode")
+    ap.add_argument("--journal-dir", default=None)
+    ap.add_argument("--progress", default=None)
+    ap.add_argument("--workdir", default=None,
+                    help="proof mode: scratch directory (default: a tempdir)")
+    ap.add_argument("--kill-after-ticks", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    if args.mode == "serve":
+        if not (args.journal_dir and args.progress):
+            ap.error("serve mode needs --journal-dir and --progress")
+        serve(args.journal_dir, args.progress)
+        return None
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="journal-crash-")
+    result = run_crash_restart(workdir, kill_after_ticks=args.kill_after_ticks)
+    result.pop("_shared", None)  # live jax objects, not part of the artifact
+    print(json.dumps(result, indent=1))
+    if not result["ok"]:
+        raise SystemExit(1)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -245,6 +245,18 @@ def report_serving_metrics(path: str) -> Dict:
         out["preemptions"] = snap.get("preemptions")
         out["preempted_replays"] = snap.get("preempted_replays")
         out["queue_wait_by_priority"] = snap.get("queue_wait_by_priority")
+        # serving-metrics/v7 journal gauges (None: journal-less engine or
+        # pre-v7 stream) + the recovery events ServingEngine.recover emits
+        out["journal"] = snap.get("journal")
+    recoveries = [e for e in loaded["events"] if e.get("event") == "recovery"]
+    if recoveries:
+        out["recoveries"] = {
+            "count": len(recoveries),
+            "sessions_recovered": sum(e.get("sessions", 0) for e in recoveries),
+            "replayed_tokens": sum(e.get("replayed_tokens", 0) for e in recoveries),
+            "torn_tails": sum(1 for e in recoveries if e.get("truncated")),
+            "dropped_records": sum(e.get("dropped_records", 0) for e in recoveries),
+        }
     lifetimes = _lifetimes_by_priority(loaded["events"])
     if lifetimes:
         out["request_lifetimes_by_priority"] = lifetimes
@@ -325,6 +337,24 @@ def main(argv=None) -> Dict:
                   f"{pool.get('pages_in_use')}/{pool.get('pages_total')} pages in use, "
                   f"pages/request p50={ppr.get('p50')} p95={ppr.get('p95')}, "
                   f"alloc failures={pool.get('alloc_failures')}")
+        # v7 journal health + recovery rendering (suppressed on journal-less
+        # engines and pre-v7 streams, where the reader normalized to None)
+        jstats = section.get("journal")
+        if jstats:
+            print("journal: "
+                  f"{jstats.get('bytes_written')} bytes / "
+                  f"{jstats.get('records_appended')} records appended, "
+                  f"{jstats.get('fsyncs')} fsyncs ({jstats.get('fsync')} policy), "
+                  f"{jstats.get('compactions')} compactions, "
+                  f"generation {jstats.get('generation')}, "
+                  f"{jstats.get('live_sessions')} live sessions")
+        rec = section.get("recoveries")
+        if rec:
+            print(f"recoveries: {rec['count']} "
+                  f"(sessions recovered: {rec['sessions_recovered']}, "
+                  f"replayed tokens: {rec['replayed_tokens']}, "
+                  f"torn tails: {rec['torn_tails']}, "
+                  f"dropped records: {rec['dropped_records']})")
         # v6 priority/preemption rendering (suppressed on pre-v6 streams,
         # where the reader normalized the fields to None)
         if section.get("preemptions") is not None:

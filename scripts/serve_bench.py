@@ -28,6 +28,13 @@ the ``PERCEIVER_IO_TPU_DISABLE_PREEMPTION`` kill-switch arm — high-priority
 p95 time-to-first-token and deadline-miss rate at equal total throughput;
 the block is merged into ``BENCH_serving.json``.
 
+``--journal`` runs the write-ahead journal overhead arm (docs/serving.md
+"Request journal"): the main staggered workload journal-on (accept-fsync
+policy) vs journal-off, interleaved median-of-``--journal-repeats`` —
+acceptance is admission tokens/s within 10% of journal-off and greedy
+outputs byte-identical across arms; the block is merged into
+``BENCH_serving.json``.
+
 ``--replicas N`` runs the replica-scaling arm (ROADMAP item 2): a burst
 workload through a 1-replica and an N-replica ``ServingRouter`` (interleaved,
 median-of-``--replica-repeats``), reporting aggregate admission tokens/s
@@ -559,6 +566,94 @@ def run_priority_preemption(model, config, params, num_slots: int, seed: int,
     }
 
 
+def run_journal_overhead(model, config, params, num_slots: int, seed: int,
+                         repeats: int = 5) -> dict:
+    """``--journal`` acceptance arm (docs/serving.md "Request journal"): the
+    same staggered mixed workload through a journal-off and a journal-on
+    engine (default ``fsync="accept"`` policy — one fsync per ACCEPT, one
+    buffered write per tick), interleaved median-of-``repeats``. The
+    accepted⇒durable guarantee must ride almost free on the decode hot loop:
+    the acceptance bound is admission tokens/s within 10% of journal-off.
+    Greedy outputs are asserted byte-identical across arms (the journal is
+    pure host-side bookkeeping), and the journal-on arm reports its own
+    write/fsync counters so the overhead has an explanation attached."""
+    import shutil
+    import tempfile
+
+    from perceiver_io_tpu.serving import ServingEngine
+
+    requests = synth_workload(config, 4 * num_slots, seed)
+
+    def one_pass(journal_dir):
+        engine = ServingEngine(model, params, num_slots=num_slots,
+                               telemetry=False, journal=journal_dir)
+        t0 = time.perf_counter()
+        handles = []
+        for i, r in enumerate(requests):
+            handles.append(engine.submit(
+                r["prompt"], max_new_tokens=r["max_new_tokens"],
+                rng=jax.random.PRNGKey(i)))
+            engine.step()
+        while engine.step():
+            pass
+        drain_wall = time.perf_counter() - t0
+        assert all(h.ok for h in handles)  # a degraded pass must not be timed
+        admit_wall = max(h.admitted_at for h in handles) - t0
+        snap = engine.metrics.snapshot()
+        tokens = [h.result().tolist() for h in handles]
+        engine.close()
+        return admit_wall, drain_wall, snap, tokens
+
+    one_pass(None)  # warmup: compiles every covering bucket + the decode step
+    walls = {"journal_off": [], "journal_on": []}
+    snaps, outputs = {}, {}
+    for _ in range(repeats):
+        for arm in walls:  # interleaved A/B: shared-CPU drift hits both arms
+            tmp = tempfile.mkdtemp(prefix="serve-bench-journal-") \
+                if arm == "journal_on" else None
+            try:
+                admit, drain, snap, tokens = one_pass(
+                    os.path.join(tmp, "j") if tmp else None)
+            finally:
+                if tmp:
+                    shutil.rmtree(tmp, ignore_errors=True)
+            walls[arm].append((admit, drain))
+            snaps[arm] = snap
+            outputs.setdefault(arm, tokens)
+            assert tokens == outputs[arm], "journal arm changed tokens"
+
+    prompt_tokens = sum(len(r["prompt"]) for r in requests)
+    new_tokens = sum(r["max_new_tokens"] for r in requests)
+    out = {"requests": len(requests), "slots": num_slots,
+           "fsync_policy": "accept",
+           "prompt_tokens_per_pass": prompt_tokens,
+           "new_tokens_per_pass": new_tokens}
+    for arm, samples in walls.items():
+        admit = _median([s[0] for s in samples])
+        drain = _median([s[1] for s in samples])
+        out[arm] = {
+            "admission_wall_seconds": round(admit, 4),
+            "admission_wall_all_repeats": [round(s[0], 4) for s in samples],
+            "admission_prompt_tokens_per_s": round(prompt_tokens / admit, 2)
+            if admit > 0 else 0.0,
+            "drain_wall_seconds": round(drain, 4),
+            "tokens_per_s": round(new_tokens / drain, 2) if drain > 0 else 0.0,
+        }
+    jstats = snaps["journal_on"]["journal"] or {}
+    out["journal_writes"] = {
+        k: jstats.get(k)
+        for k in ("bytes_written", "records_appended", "fsyncs", "compactions")
+    }
+    out["outputs_identical_across_arms"] = (
+        outputs["journal_off"] == outputs["journal_on"]
+    )
+    off = out["journal_off"]["admission_prompt_tokens_per_s"]
+    on = out["journal_on"]["admission_prompt_tokens_per_s"]
+    out["admission_overhead_ratio"] = round(off / on, 3) if on > 0 else 0.0
+    out["admission_within_10pct"] = bool(on > 0 and off / on <= 1.10)
+    return out
+
+
 def run_baseline(model, params, requests, warmup: bool):
     """Single-request serving: generate() per request, back-to-back, on the
     canonical padded shape (prompt left-padded to the full window)."""
@@ -805,6 +900,14 @@ def main(argv=None) -> dict:
                          "arm (hi-prio TTFT p95 + deadline-miss rate); the "
                          "block lands in the --profile-out artifact")
     ap.add_argument("--priority-repeats", type=int, default=3)
+    ap.add_argument("--journal", action="store_true",
+                    help="run the write-ahead journal overhead arm: the main "
+                         "workload journal-on (accept-fsync policy) vs "
+                         "journal-off, interleaved median-of "
+                         "--journal-repeats (acceptance: admission tokens/s "
+                         "within 10%%); the block lands in the --profile-out "
+                         "artifact (BENCH_serving.json)")
+    ap.add_argument("--journal-repeats", type=int, default=5)
     ap.add_argument("--replicas", type=int, default=0,
                     help="run the replica-scaling arm: a burst workload through "
                          "a 1-replica vs N-replica ServingRouter (interleaved, "
@@ -826,6 +929,12 @@ def main(argv=None) -> dict:
     def priority_arm(model, config, params):
         block = run_priority_preemption(model, config, params, args.slots,
                                         args.seed, repeats=args.priority_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def journal_arm(model, config, params):
+        block = run_journal_overhead(model, config, params, args.slots,
+                                     args.seed, repeats=args.journal_repeats)
         block["preset"] = args.preset
         return block
 
@@ -884,6 +993,8 @@ def main(argv=None) -> dict:
             result["paging"] = paging_arm(model, config, profile_params)
         if args.priority_arm:
             result["priority_preemption"] = priority_arm(model, config, profile_params)
+        if args.journal:
+            result["journal"] = journal_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
@@ -940,6 +1051,10 @@ def main(argv=None) -> dict:
         priority = priority_arm(model, config, params)
         result["priority_preemption"] = priority
         merge_section("priority_preemption", priority, result["recorded_at"])
+    if args.journal:
+        journal = journal_arm(model, config, params)
+        result["journal"] = journal
+        merge_section("journal", journal, result["recorded_at"])
 
     tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
     with open(tmp, "w") as f:

@@ -55,3 +55,40 @@ def test_checker_detects_missing_var(tmp_path):
     assert mod.check(repo=str(tmp_path))["ok"]
     # a bare prose glob ("PERCEIVER_IO_TPU_*") never counts as documentation
     assert "PERCEIVER_IO_TPU_" not in mod.documented_env_vars(str(tmp_path))
+
+
+def test_schema_versions_tracked():
+    """ISSUE 10 satellite: the guard also pins versioned artifact schemas —
+    the newest serving-metrics version the package stamps must be the one
+    docs/serving.md documents (the v4→v5→v6 doc races)."""
+    mod = _load()
+    result = mod.check()
+    fam = result["schemas"]["serving-metrics"]
+    assert fam["ok"], fam
+    # not vacuous: the package really references a versioned schema and the
+    # doc really mentions that exact version
+    assert fam["newest_package_version"] is not None
+    assert fam["newest_package_version"] in fam["documented_versions"]
+
+
+def test_schema_guard_detects_doc_lag(tmp_path):
+    """A fake repo whose package bumps the schema without the doc fails; the
+    doc catching up passes (older versions lingering in both is fine)."""
+    mod = _load()
+    pkg = tmp_path / "perceiver_io_tpu"
+    pkg.mkdir()
+    (pkg / "metrics.py").write_text('SCHEMA = "serving-metrics/v9"\n'
+                                    'OLD = "serving-metrics/v8"\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "serving.md").write_text("## Metrics schema (`serving-metrics/v8`)\n")
+    (tmp_path / "README.md").write_text("# nothing\n")
+    result = mod.check(repo=str(tmp_path))
+    assert not result["ok"]
+    fam = result["schemas"]["serving-metrics"]
+    assert not fam["ok"] and fam["newest_package_version"] == 9
+    # doc catches up -> green, even with v8 still mentioned in the package
+    (docs / "serving.md").write_text(
+        "## Metrics schema (`serving-metrics/v9`)\nv8 added things.\n"
+        "serving-metrics/v8 remains readable.\n")
+    assert mod.check(repo=str(tmp_path))["ok"]

@@ -1,6 +1,6 @@
 """Multi-replica router tests: dispatch parity, deterministic failover
 (token-identity at bucket boundaries, float64), circuit-breaker state
-machine, SLO shedding, churn/compile bounds, serving-metrics/v6, and the
+machine, SLO shedding, churn/compile bounds, serving-metrics/v7, and the
 SIGTERM/SIGINT graceful drain.
 
 The failover contract (docs/serving.md, router section): after a replica is
@@ -83,7 +83,7 @@ def test_router_greedy_parity_mixed_lengths(x64):
         assert handle.failovers == 0
     # load-based dispatch actually spread the work
     snap = router.snapshot()
-    assert snap["schema"] == "serving-metrics/v6"
+    assert snap["schema"] == "serving-metrics/v7"
     assert all(s["requests_admitted"] > 0 for s in snap["replicas"].values())
     assert snap["failovers"] == 0 and snap["breaker_transitions"] == {}
     router.close()
@@ -473,12 +473,12 @@ def test_router_metrics_v4_jsonl_and_reader(tmp_path):
     events = {e["event"] for e in got["events"]}
     assert {"submit", "dispatch", "failover", "breaker", "shed", "finish", "snapshot"} <= events
     snap = got["snapshots"][0]
-    assert snap["schema"] == "serving-metrics/v6"
+    assert snap["schema"] == "serving-metrics/v7"
     assert snap["failovers"] == 1 and snap["shed_infeasible"] == 1
     assert snap["breaker_transitions"] == {"closed->open": 1}
     assert snap["tokens_generated"] == 1  # aggregated over replica sections
     assert set(snap["replicas"]) == {"r0", "r1"}
-    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v6"
+    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v7"
 
     bad = tmp_path / "bad.jsonl"
     bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v9"}) + "\n")
@@ -634,3 +634,231 @@ def test_engine_sigterm_graceful_drain(setup, tmp_path):
     got = load_metrics_jsonl(str(log))
     assert got["snapshots"] and got["snapshots"][-1]["requests_finished"] == 1
     engine.close()
+
+
+# --------------------------------------------------- pending expiry (ISSUE 10)
+def test_expire_pending_terminal_event_carries_partial_tokens(setup, tmp_path):
+    """ISSUE 10 satellite: a TTL-expired PARKED failover continuation — held
+    in the router queue because no replica is healthy — goes TIMED_OUT with
+    its already-emitted partial tokens on both the handle and the terminal
+    metrics event, mirroring the parked-deadline contract PR 9 pinned for
+    preempted continuations. A silent loss (or a zero-token terminal event)
+    here would make the failover salvage unauditable."""
+    import time as _time
+
+    model, params = setup
+    log = tmp_path / "router.jsonl"
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           breaker_cooldown_ticks=512,  # stays OPEN throughout
+                           metrics_jsonl=str(log))
+    warm = router.submit([9, 9], max_new_tokens=1)  # compile outside the TTL
+    router.run_until_drained(max_steps=50)
+    assert warm.ok
+    victim = router.submit([1, 2, 3], max_new_tokens=10, deadline_s=2.0)
+    k = 3
+    for _ in range(k):
+        router.step()
+    assert len(victim.output_ids) == k
+    with armed("replica.crash", slot=victim.replica, times=1):
+        router.step()  # replica lost; the only replica -> continuation PARKS
+    assert not victim.done and victim.status is RequestStatus.QUEUED
+    assert len(victim.output_ids) == k  # salvage kept while parked
+
+    deadline = _time.perf_counter() + 10.0
+    while not victim.done and _time.perf_counter() < deadline:
+        router.step()  # the fleet is down; only _expire_pending can act
+        _time.sleep(0.02)
+    assert victim.status is RequestStatus.TIMED_OUT
+    assert victim.finish_reason == "deadline"
+    assert victim.result().tolist() and len(victim.result()) == k  # partials kept
+
+    got = load_metrics_jsonl(str(log))
+    finish = next(e for e in got["events"]
+                  if e["event"] == "finish" and e["request_id"] == victim.request_id)
+    assert finish["status"] == "timed_out"
+    assert finish["new_tokens"] == k  # the terminal EVENT carries the salvage
+    router.close()
+
+
+# ------------------------------------------------------- journal recovery
+def test_router_journal_recovery_f64_identity(x64, tmp_path):
+    """ISSUE 10: ``ServingRouter.recover`` rebuilds the whole fleet from the
+    per-replica journals after process death — every accepted session
+    completes f64 token-identical to an uninterrupted run, placement
+    preserved, and a post-recovery drain finishes in-flight continuations
+    while rejecting only never-admitted backlog."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9], [40, 41, 42, 43], [100, 101], [250]]
+    max_new = [5, 4, 6, 3]
+    expected = _engine_reference(model, params, prompts, max_new)
+
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           journal=template)
+    handles = [router.submit(p, max_new_tokens=m)
+               for p, m in zip(prompts, max_new)]
+    for _ in range(2):
+        router.step()  # two running (one per replica), two queued, mid-decode
+    # process death: the router object is abandoned; recover a fresh fleet
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=2, num_slots=1)
+    assert info["sessions"] == 4
+    router2.run_until_drained(max_steps=500)
+    by_prompt = {tuple(h.prompt_ids.tolist()): h for h in info["handles"]}
+    for p, want in zip(prompts, expected):
+        h = by_prompt[tuple(p)]
+        assert h.ok, f"prompt {p}: {h.status}"
+        assert h.result().tolist() == want, f"prompt {p} diverged after recovery"
+    # zero extra compiled programs during replay, fleet-wide
+    for r in router2.replicas:
+        assert r.engine.decode_compilations == 1
+    snap = router2.snapshot()
+    assert snap["requests_submitted"] == 4 == snap["requests_finished"]
+    router2.close()
+
+
+def test_router_journal_template_validation(setup, tmp_path):
+    model, params = setup
+    with pytest.raises(ValueError, match="template"):
+        ServingRouter(model, params, num_replicas=2,
+                      journal=str(tmp_path / "flat"))
+    with pytest.raises(ValueError, match="template"):
+        ServingRouter.recover(model, params, str(tmp_path / "flat"),
+                              num_replicas=2)
+
+
+def test_dispatch_journal_failure_contained_as_replica_fault(setup, tmp_path):
+    """Code-review fix: a journal append failure inside a replica's
+    ``submit()`` (real ENOSPC/EIO, or a fail-stopped journal refusing
+    appends) is contained as a REPLICA fault — breaker strike, request
+    placed on a healthy sibling — instead of propagating out of
+    ``router.submit()`` and crashing the fleet on one replica's disk."""
+    model, params = setup
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           journal=template)
+    # the torn write hits r0's journal (least-loaded tie -> lowest index)
+    with armed("serving.journal.torn_write", times=1):
+        h = router.submit([1, 2, 3], max_new_tokens=3)
+    assert h.replica == 1  # contained: landed on the healthy sibling
+    assert router.replicas[0].engine.journal.failed
+    router.run_until_drained(max_steps=200)
+    assert h.ok and len(h.result()) == 3
+    # the fail-stopped journal refuses appends FOREVER: every later dispatch
+    # attempt at r0 strikes its breaker, and the fleet keeps serving
+    handles = [router.submit([i + 2, i + 3], max_new_tokens=2)
+               for i in range(6)]
+    router.run_until_drained(max_steps=400)
+    assert all(hh.ok for hh in handles)
+    assert all(hh.replica == 1 for hh in handles)
+    snap = router.snapshot()
+    assert snap["requests_submitted"] == 7 == snap["requests_finished"]
+    router.close()
+
+
+def test_failover_origin_closed_no_duplicate_recovery(x64, tmp_path):
+    """Code-review fix: once a failover LANDS on a new replica (fresh accept
+    journaled there, replay prefix included), the origin replica's journal
+    entry is closed — a process death in that window must recover the
+    session exactly ONCE. Previously both journals held it live and
+    ``ServingRouter.recover`` executed the same logical request twice."""
+    from perceiver_io_tpu.serving import read_journal
+
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9]]
+    expected = _engine_reference(model, params, prompts, [6])
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           journal=template)
+    victim = router.submit(prompts[0], max_new_tokens=6)
+    for _ in range(2):
+        router.step()  # running on r0, mid-decode
+    assert victim.replica == 0
+    with armed("replica.crash", slot=0, times=1):
+        router.step()  # r0 lost; the failover LANDS on healthy r1
+    assert victim.replica == 1
+    # the origin entry is closed: r0's journal holds no live session, r1's
+    # fresh accept is now the continuation's one durable copy
+    assert read_journal(template.format(i=0)).sessions == []
+    assert len(read_journal(template.format(i=1)).sessions) == 1
+    # process death NOW (the duplicate-execution window): recover the fleet
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=2, num_slots=1)
+    assert info["sessions"] == 1  # exactly once, not once per journal
+    router2.run_until_drained(max_steps=300)
+    h = info["handles"][0]
+    assert h.ok
+    assert h.result().tolist() == expected[0]
+    snap = router2.snapshot()
+    assert snap["requests_submitted"] == 1 == snap["requests_finished"]
+    router2.close()
+
+
+def test_parked_continuation_durable_across_process_death(x64, tmp_path):
+    """Code-review fix: a failover continuation PARKED at the router (no
+    healthy replica to land on) keeps its origin replica's journal entry
+    LIVE — it is the session's only durable copy. Process death while parked
+    recovers the session from that journal, token-identical, instead of
+    losing accepted work."""
+    from perceiver_io_tpu.serving import read_journal
+
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9]]
+    expected = _engine_reference(model, params, prompts, [6])
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           journal=template, breaker_cooldown_ticks=512)
+    victim = router.submit(prompts[0], max_new_tokens=6)
+    for _ in range(2):
+        router.step()  # mid-decode
+    with armed("replica.crash", slot=0, times=1):
+        router.step()  # only replica lost -> continuation PARKS
+    assert not victim.done and victim.status is RequestStatus.QUEUED
+    assert victim.replica is None
+    # parked: the origin journal still holds the session live
+    assert len(read_journal(template.format(i=0)).sessions) == 1
+    # process death while parked: the origin journal recovers the session
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=1, num_slots=1)
+    assert info["sessions"] == 1
+    router2.run_until_drained(max_steps=300)
+    h = info["handles"][0]
+    assert h.ok
+    assert h.result().tolist() == expected[0]
+    router2.close()
+
+
+def test_parked_expiry_closes_origin_journal_entry(setup, tmp_path):
+    """Code-review fix companion: a parked continuation that resolves
+    terminally at the ROUTER (TTL expiry) closes its origin journal entry
+    with the real outcome — a later recovery must not resurrect a request
+    the caller already saw go terminal."""
+    import time as _time
+
+    from perceiver_io_tpu.serving import read_journal
+
+    model, params = setup
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           journal=template, breaker_cooldown_ticks=512)
+    warm = router.submit([9, 9], max_new_tokens=1)  # compile outside the TTL
+    router.run_until_drained(max_steps=50)
+    assert warm.ok
+    victim = router.submit([1, 2, 3], max_new_tokens=10, deadline_s=1.5)
+    for _ in range(2):
+        router.step()
+    with armed("replica.crash", slot=0, times=1):
+        router.step()  # only replica lost -> continuation PARKS
+    assert victim.status is RequestStatus.QUEUED
+    deadline = _time.perf_counter() + 10.0
+    while not victim.done and _time.perf_counter() < deadline:
+        router.step()
+        _time.sleep(0.02)
+    assert victim.status is RequestStatus.TIMED_OUT
+    # the origin entry closed with the real outcome: nothing to resurrect
+    assert read_journal(template.format(i=0)).sessions == []
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=1, num_slots=1)
+    assert info["sessions"] == 0
+    router2.close()
+    router.close()
