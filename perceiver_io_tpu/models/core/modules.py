@@ -192,6 +192,39 @@ class CrossAttention(nn.Module):
         )
 
 
+    def prefill_chunk_kv(
+        self, x_emb: jax.Array, latent_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Chunked prefill's position-wise half (docs/serving.md "Chunked
+        prefill"): the cross-attention KV rows for a chunk of prompt-token
+        embeddings, with NO attention — each row is a pure function of its own
+        token and position. The norm choice per row reproduces the one-shot
+        prefill's concat exactly: prefix positions contribute
+        ``kv_norm(x_emb)``, latent-region positions (``latent_mask`` True)
+        contribute ``q_norm(x_emb)`` — the query rows re-used as keys in the
+        Perceiver AR concat (see ``__call__``'s x_kv construction)."""
+        x_kv = jnp.where(latent_mask[..., None], self.q_norm(x_emb), self.kv_norm(x_emb))
+        return self.attention.project_kv(x_kv)
+
+    def prefill_latents_paged(
+        self,
+        x_q: jax.Array,
+        k_rows: jax.Array,
+        v_rows: jax.Array,
+        visible: jax.Array,
+        rope_q: Optional[jax.Array] = None,
+        rope_k: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Chunked prefill's finish half: the latent queries (raw embeddings —
+        q_norm applies here, as in ``__call__``) attend against the slot's
+        already-written KV pages under the caller's visibility/causality
+        bound. No cache append — the chunk writes already hold every key."""
+        x_q = self.q_norm(x_q)
+        return self.attention.paged_prefill_attention(
+            x_q, k_rows, v_rows, visible, rope_q=rope_q, rope_k=rope_k
+        )
+
+
 class SelfAttention(nn.Module):
     """Pre-layer-norm self-attention (q = k = v = norm(x))."""
 
@@ -317,6 +350,30 @@ class CrossAttentionLayer(nn.Module):
         x = att + x_q if self.attention_residual else att
         x = x + self.res_dropout(self.mlp(x), deterministic=self.deterministic)
         return x, kv_cache
+
+    def prefill_chunk_kv(self, x_emb: jax.Array, latent_mask: jax.Array):
+        """Chunked-prefill KV rows (see ``CrossAttention.prefill_chunk_kv``);
+        the layer adds nothing position-wise — residual/MLP act on queries."""
+        return self.cross_attn.prefill_chunk_kv(x_emb, latent_mask)
+
+    def prefill_latents_paged(
+        self,
+        x_q: jax.Array,
+        k_rows: jax.Array,
+        v_rows: jax.Array,
+        visible: jax.Array,
+        rope_q=None,
+        rope_k=None,
+    ) -> jax.Array:
+        """Chunked-prefill finish through the full layer: paged attention +
+        the same residual/MLP the one-shot prefill applies to its latents."""
+        att = self.cross_attn.prefill_latents_paged(
+            x_q, k_rows, v_rows, visible, rope_q=rope_q, rope_k=rope_k
+        )
+        att = self.res_dropout(att, deterministic=self.deterministic)
+        x = att + x_q if self.attention_residual else att
+        x = x + self.res_dropout(self.mlp(x), deterministic=self.deterministic)
+        return x
 
 
 class SelfAttentionLayer(nn.Module):

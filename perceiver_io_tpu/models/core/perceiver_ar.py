@@ -170,28 +170,42 @@ class PagedPerceiverARCache(flax.struct.PyTreeNode):
         (``src``: batch-1 DENSE cache at bucket capacity, straight from the
         shared prefill program) into pool slot ``slot`` whose page table row
         becomes ``table_row`` (P,) — the first ceil(bucket/page) entries are
-        the freshly allocated pages that receive the bucket's KV rows
+        the freshly allocated pages that receive the prompt's KV rows
         page-by-page, the remainder are the request's decode-growth
         reservation (content written later by ``append_token``) padded with
-        the trash page. The ring offset starts at ``bucket mod window`` so
-        bucket row j lands at physical ring position j: positionally the
-        dense ``write_slot`` tail-scatter in a rotated frame (logical
-        position of ring slot j is ``(j - bucket) mod window`` = window -
-        bucket + j for the bucket rows), with the head left-pad represented
-        by ``live``/``shift`` alone instead of a zero-filled buffer."""
+        the trash page.
+
+        The layout is PAGE-ALIGNED on the prompt (docs/serving.md "Prefix
+        cache"): the bucket's left-pad head is rolled out so prompt token i
+        lands at physical ring position i — page ``i // page_size``, offset
+        ``i % page_size`` — and the ring offset starts at ``n mod window``
+        (n = live prompt length). Page k's contents are therefore a pure
+        function of prompt tokens ``[k*ps, (k+1)*ps)`` alone, independent of
+        the covering bucket and the tail beyond the page — the property the
+        cross-request prefix cache keys on. Positionally this is the dense
+        ``write_slot`` tail-scatter in a rotated frame (ring slot i holds
+        logical window position ``window - n + i``), with the head left-pad
+        represented by ``live``/``shift`` alone instead of a zero-filled
+        buffer. The rolled-out pad rows land past position n as inert
+        garbage: never visible (``live`` bounds the window) and overwritten
+        by decode appends before they ever could be."""
         ps = self.ca.page_size
         window = self.ca.window
         bucket = src.ca.capacity
-        nb = -(-bucket // ps)  # pages holding bucket content
+        nb = -(-bucket // ps)  # pages holding prompt (+ inert tail) content
         pad_rows = nb * ps - bucket
-        kc = jnp.pad(src.ca.k[0], ((0, pad_rows), (0, 0))).astype(self.ca.kp.dtype)
-        vc = jnp.pad(src.ca.v[0], ((0, pad_rows), (0, 0))).astype(self.ca.vp.dtype)
+        shift = src.shift[0, 0]  # left-pad count: bucket - n
+        n = bucket - shift  # live prompt length
+        kc = jnp.roll(src.ca.k[0], -shift, axis=0)
+        vc = jnp.roll(src.ca.v[0], -shift, axis=0)
+        kc = jnp.pad(kc, ((0, pad_rows), (0, 0))).astype(self.ca.kp.dtype)
+        vc = jnp.pad(vc, ((0, pad_rows), (0, 0))).astype(self.ca.vp.dtype)
         ids = table_row[:nb]
         ca = self.ca.replace(
             kp=self.ca.kp.at[ids].set(kc.reshape(nb, ps, -1)),
             vp=self.ca.vp.at[ids].set(vc.reshape(nb, ps, -1)),
             page_table=self.ca.page_table.at[slot].set(table_row),
-            start=self.ca.start.at[slot].set(bucket % window),
+            start=self.ca.start.at[slot].set(jnp.mod(n, window)),
         )
         return self.replace(
             ca=ca,
@@ -200,6 +214,31 @@ class PagedPerceiverARCache(flax.struct.PyTreeNode):
                 self.shift, src.shift + (window - bucket), slot, axis=0
             ),
             live=jax.lax.dynamic_update_slice_in_dim(self.live, src.live, slot, axis=0),
+        )
+
+    def install_finish(
+        self, slot: jax.Array, table_row: jax.Array, sa_src: KVCache, live: jax.Array
+    ) -> "PagedPerceiverARCache":
+        """Device half of the chunked-prefill FINISH (docs/serving.md
+        "Chunked prefill"): the slot's CA pages were already written by
+        ``PagedKVCache.write_rows`` chunks (through ``table_row`` directly —
+        the in-cache table stayed trash so interleaved decode ticks could
+        not corrupt the half-built slot), so installing the slot is pure
+        bookkeeping: point the table at the reservation, set the ring offset
+        to ``live mod window`` (the page-aligned layout's post-prompt
+        append point), write the finish step's self-attention cache, and pin
+        shift/live exactly as ``install_slot`` would for a prompt of
+        ``live`` tokens."""
+        window = self.ca.window
+        live = jnp.asarray(live, jnp.int32)
+        return self.replace(
+            ca=self.ca.replace(
+                page_table=self.ca.page_table.at[slot].set(table_row),
+                start=self.ca.start.at[slot].set(jnp.mod(live, window)),
+            ),
+            sa=self.sa.write_batch_row(slot, sa_src, batch_axis=1),
+            shift=self.shift.at[slot].set(window - live),
+            live=self.live.at[slot].set(live),
         )
 
     def release_slot(self, slot: jax.Array) -> "PagedPerceiverARCache":
@@ -607,6 +646,74 @@ class PerceiverAR(nn.Module):
         )
         return x_latent, cache.replace(ca=ca_cache, sa=sa_cache, live=live)
 
+    # ------------------------------------------------------------ chunked prefill
+    def prefill_chunk_kv(
+        self, x: jax.Array, abs_pos: jax.Array, latent_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One chunk of the split prefill (docs/serving.md "Chunked
+        prefill"): the cross-attention KV rows for prompt tokens ``x``
+        (1, C) at absolute positions ``abs_pos`` — position-wise math only
+        (embed + norm + k/v projection), NO attention, so a chunk's cost is
+        O(chunk) with a tiny constant. ``latent_mask`` marks rows inside the
+        prompt's latent region (position >= n - max_latents), which the
+        one-shot prefill's KV concat normalizes with ``q_norm`` rather than
+        ``kv_norm`` — reproduced row-for-row so a chunk-built page is
+        byte-interchangeable with an install-built one."""
+        x_emb, _frq = self.input_adapter(x, abs_pos=abs_pos)
+        return self.cross_attention.prefill_chunk_kv(x_emb, latent_mask)
+
+    def prefill_latents_paged(
+        self, x: jax.Array, n_live: jax.Array, ca: PagedKVCache, table_row: jax.Array
+    ) -> Tuple[jax.Array, KVCache]:
+        """The split prefill's FINISH step: compute the latents for a slot
+        whose prompt KV already sits page-aligned in the pool (written by
+        ``prefill_chunk_kv`` chunks and/or shared prefix-cache pages). ``x``
+        (1, L = max_latents) are the prompt's LAST L tokens, ``n_live`` the
+        traced prompt length (n >= L — shorter prompts take the one-shot
+        path), ``table_row`` the slot's page reservation. Queries attend to
+        the gathered pages under the page-aligned visibility bound — key
+        ring position r holds prompt position r, visible to query j iff
+        r < n and r <= n - L + j (exactly the one-shot prefill's pad +
+        causal masking in the rotated frame) — then run the standard
+        self-attention stack into a fresh bucket-shaped SA cache. ONE
+        compiled program ever: every shape here is static (L, the window,
+        the page count), n/slot/table ride as traced data."""
+        b, latents = x.shape
+        window = ca.window
+        rot = self._rotated_dim()
+        n = jnp.asarray(n_live, jnp.int32)
+        q_pos = jnp.maximum(n - latents + jnp.arange(latents)[None, :], 0)
+        x_emb, frq_q = self.input_adapter(x, abs_pos=q_pos)
+
+        k_rows = ca.kp[table_row].reshape(1, -1, ca.kp.shape[-1])
+        v_rows = ca.vp[table_row].reshape(1, -1, ca.vp.shape[-1])
+        n_phys = k_rows.shape[1]
+        start = jnp.mod(n, window)
+        logical = jnp.mod(jnp.arange(n_phys)[None, :] - start, window)
+        slot_pos = jnp.maximum(logical - (window - n), 0)
+        rope_k = frequency_position_encoding(slot_pos, rot)
+        r = jnp.arange(n_phys)[None, :]
+        live_ok = (logical >= window - n) & (r < window)  # (1, n_phys)
+        causal = logical[:, None, :] <= (
+            window - latents + jnp.arange(latents)
+        )[None, :, None]  # (1, L, n_phys)
+        visible = live_ok[:, None, :] & causal
+
+        x_latent = self.cross_attention.prefill_latents_paged(
+            x_emb, k_rows, v_rows, visible, rope_q=frq_q, rope_k=rope_k
+        )
+        num_channels = self.input_adapter.num_input_channels
+        sa_fresh = KVCache.create_stacked(
+            self.num_self_attention_layers, b, latents, num_channels,
+            num_channels, ca.kp.dtype,
+        )
+        sa_slot_pos = jnp.maximum(n - latents + jnp.arange(latents)[None, :], 0)
+        rope_k_sa = frequency_position_encoding(sa_slot_pos, rot)
+        x_latent, sa_cache = self.self_attention(
+            x_latent, rope_q=frq_q, rope_k=rope_k_sa, kv_cache=sa_fresh
+        )
+        return x_latent, sa_cache
+
 
 class CausalSequenceModel(nn.Module):
     """Perceiver AR + token input adapter + optional final LN + tied token head."""
@@ -750,6 +857,25 @@ class CausalSequenceModel(nn.Module):
             batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers,
             cfg.num_channels, num_pages, page_size, dtype,
         )
+
+    def prefill_chunk_kv(
+        self, x: jax.Array, abs_pos: jax.Array, latent_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Chunked prefill's per-chunk KV rows; see
+        ``PerceiverAR.prefill_chunk_kv`` (the head plays no part — chunks
+        produce keys/values, never logits)."""
+        return self.ar.prefill_chunk_kv(x, abs_pos, latent_mask)
+
+    def prefill_finish_paged(
+        self, x: jax.Array, n_live: jax.Array, ca: PagedKVCache, table_row: jax.Array
+    ) -> Tuple[jax.Array, KVCache]:
+        """Chunked prefill's finish: latents over the slot's pages, through
+        the head. Returns (last-position logits (1, V), the batch-1 SA cache
+        to install); see ``PerceiverAR.prefill_latents_paged``. The head runs
+        over the full latent block and slices, mirroring the one-shot
+        prefill's ``logits[:, -1]`` exactly."""
+        hidden, sa_cache = self.ar.prefill_latents_paged(x, n_live, ca, table_row)
+        return self._head(hidden)[:, -1], sa_cache
 
     def decode_step_paged(
         self, x: jax.Array, cache: PagedPerceiverARCache

@@ -256,6 +256,51 @@ class MultiHeadAttention(nn.Module):
         o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
         return self.o_proj(o), kv_cache
 
+    def paged_prefill_attention(
+        self,
+        x_q: jax.Array,
+        k_rows: jax.Array,
+        v_rows: jax.Array,
+        visible: jax.Array,
+        rope_q: Optional[jax.Array] = None,
+        rope_k: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Multi-query attention of the prefill-finish latents against ONE
+        slot's gathered KV pages (docs/serving.md "Chunked prefill"): ``x_q``
+        (1, L, D) are the already-normed latent inputs, ``k_rows``/``v_rows``
+        (1, n_phys, C) the slot's page rows in PHYSICAL ring order (unsplit,
+        unrotated — exactly as chunk writes left them), and ``visible``
+        (1, L, n_phys) the caller-computed per-query bound combining the
+        (start, live) paged visibility with the latents' causal order. The
+        arithmetic mirrors the module's XLA masked-softmax formulation (fp32
+        scores, finfo-min mask, softmax, value sum in the cache dtype) so the
+        finish step's latents track the one-shot prefill's token-for-token."""
+        if self.dropout > 0.0 and not self.deterministic:
+            raise ValueError("paged prefill is inference-only (no attention dropout)")
+        num_qk, _num_v, _ = self._dims()
+        scale = (num_qk // self.num_heads) ** -0.5
+        n_q = x_q.shape[1]
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], self.num_heads, -1).transpose(0, 2, 1, 3)
+        q = split(self.q_proj(x_q)) * scale
+        if rope_q is not None:
+            q = apply_rope(q, rope_q)
+        kf, vf = split(k_rows), split(v_rows)
+        if rope_k is not None:
+            kf = apply_rope(kf, rope_k)
+        attn = jnp.einsum("bhic,bhjc->bhij", q, kf, preferred_element_type=jnp.float32)
+        neg = jnp.finfo(attn.dtype).min
+        attn = jnp.where(visible[:, None, :, :], attn, neg)
+        attn = jax.nn.softmax(attn, axis=-1).astype(vf.dtype)
+        o = jnp.einsum("bhij,bhjc->bhic", attn, vf)
+        o = o.transpose(0, 2, 1, 3).reshape(o.shape[0], n_q, -1)
+        return self.o_proj(o)
+
+    def project_kv(self, x_kv: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Key/value projections of already-normed inputs — the chunked
+        prefill's per-token write path (position-wise: no attention, no
+        queries). Matches what cached prefill appends row-for-row."""
+        return self.k_proj(x_kv), self.v_proj(x_kv)
+
     def __call__(
         self,
         x_q: jax.Array,

@@ -102,6 +102,41 @@ class PagedKVCache(flax.struct.PyTreeNode):
             start=jnp.mod(self.start + 1, self.window),
         )
 
+    def write_rows(
+        self,
+        table_row: jax.Array,
+        offset: jax.Array,
+        count: jax.Array,
+        k_rows: jax.Array,
+        v_rows: jax.Array,
+    ) -> "PagedKVCache":
+        """Bulk-write ``count`` consecutive KV rows of ONE slot's ring —
+        physical positions ``[offset, offset + count)`` — through the slot's
+        ``table_row`` (P,), the chunked-prefill write primitive
+        (docs/serving.md "Chunked prefill"). ``k_rows``/``v_rows`` are
+        (C_max, channels) with a STATIC row capacity drawn from the prefill
+        bucket ladder; rows at index >= ``count`` (chunk padding) are routed
+        to the trash page 0 with a ZERO payload, so duplicate trash-page
+        scatter indices carry identical payloads and the pool stays
+        deterministic (the quarantine discipline). Real rows always map to
+        allocated table entries: the engine only writes positions inside the
+        slot's reservation, and never below a shared prefix's boundary."""
+        cmax = k_rows.shape[0]
+        ps = self.page_size
+        p = self.page_table.shape[1]
+        j = jnp.arange(cmax)
+        phys = offset + j
+        real = j < count
+        pidx = jnp.clip(phys // ps, 0, p - 1)
+        page_ids = jnp.where(real, table_row[pidx], 0)
+        offs = jnp.where(real, phys % ps, 0)
+        kz = jnp.where(real[:, None], k_rows, 0).astype(self.kp.dtype)
+        vz = jnp.where(real[:, None], v_rows, 0).astype(self.vp.dtype)
+        return self.replace(
+            kp=self.kp.at[page_ids, offs].set(kz),
+            vp=self.vp.at[page_ids, offs].set(vz),
+        )
+
     def gather_dense(self):
         """(B, P*page_size, C) dense view through the page table — the XLA
         fallback's input. Materializes the full logical window per row; the

@@ -35,9 +35,13 @@ from perceiver_io_tpu.serving.metrics import (
 )
 from perceiver_io_tpu.serving.paging import (
     PagePool,
+    PrefixCache,
+    chunked_prefill_enabled,
+    page_keys_for_prompt,
     paged_kv_enabled,
     pages_for_request,
     pages_for_tokens,
+    prefix_cache_enabled,
 )
 from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
@@ -51,10 +55,14 @@ __all__ = [
     "journal_enabled",
     "read_journal",
     "PagePool",
+    "PrefixCache",
+    "chunked_prefill_enabled",
+    "page_keys_for_prompt",
     "paged_kv_enabled",
     "pages_for_request",
     "pages_for_tokens",
     "preemption_enabled",
+    "prefix_cache_enabled",
     "RequestStatus",
     "RoutedRequest",
     "RouterMetrics",

@@ -155,7 +155,15 @@ from perceiver_io_tpu.serving.journal import (
     read_journal,
 )
 from perceiver_io_tpu.serving.metrics import EngineMetrics
-from perceiver_io_tpu.serving.paging import PagePool, paged_kv_enabled, pages_for_request
+from perceiver_io_tpu.serving.paging import (
+    PagePool,
+    PrefixCache,
+    chunked_prefill_enabled,
+    page_keys_for_prompt,
+    paged_kv_enabled,
+    pages_for_request,
+    prefix_cache_enabled,
+)
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
 
@@ -253,6 +261,11 @@ class ServedRequest:
     # into ``output_ids`` (the handle carries the full stream).
     replay_ids: Optional[np.ndarray] = None
     replay_pos: int = 0
+    # prefix-cache engines: the prompt's CACHEABLE page keys (page-aligned
+    # token tuples strictly below the latent boundary — serving/paging.py),
+    # computed ONCE at submit; the admission gate and engine.load walk the
+    # queue with them per tick, so re-deriving would be O(queue * prompt)
+    page_keys: Optional[tuple] = None
 
     @property
     def done(self) -> bool:
@@ -312,6 +325,27 @@ def _journal_config_payload(config: GenerationConfig) -> dict:
     return {k: getattr(config, k) for k in _JOURNAL_CONFIG_FIELDS}
 
 
+@dataclass
+class _PrefillTask:
+    """Host-side state of one slot's SPLIT admission prefill (docs/serving.md
+    "Chunked prefill"): the slot is claimed and its reservation allocated,
+    but the request decodes nothing until the finish step activates it —
+    between ticks the slot's in-cache page table stays trash so interleaved
+    decode appends cannot touch the half-built pages (chunks write through
+    ``table_row`` directly)."""
+
+    request: ServedRequest
+    table_row: np.ndarray  # (P,) trash-padded reservation (shared + private)
+    n: int  # prompt length
+    bucket: int  # covering ladder bucket (metrics continuity)
+    next_pos: int  # next prompt position whose KV is still unwritten
+    chunk_budget: int  # tokens per chunk dispatch
+    shared_pages: int  # prefix-cache pages reused (page-aligned head)
+    t0: float  # first-chunk dispatch time (prefill_s measures the span)
+    resumed: bool = False  # a PREEMPTED continuation re-admitting (replay)
+    chunks: int = 0  # chunks dispatched so far
+
+
 # distinguishes concurrent engines' lifecycle spans in a shared recorder
 _ENGINE_IDS = itertools.count()
 
@@ -356,6 +390,9 @@ class ServingEngine:
         priority_aging_ticks: Optional[int] = None,
         max_preemptions: int = 2,
         journal=None,
+        prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: bool = False,
+        max_prefill_slots: Optional[int] = None,
     ):
         self.model = model
         self.params = params
@@ -464,6 +501,7 @@ class ServingEngine:
         self._vocab = cfg.vocab_size
         self._window = model.max_seq_len
         self._prefix_len = model.max_prefix_len
+        self._latents = model.max_latents
 
         # Prefill bucket ladder (ascending, ends at the window): a prompt is
         # prefilled at the smallest covering bucket — cost O(bucket) — and
@@ -538,6 +576,42 @@ class ServingEngine:
                 sa=cache.sa.replace(length=jnp.full_like(cache.sa.length, cache.sa.k.shape[2])),
                 live=jnp.full((num_slots,), cache.ca.capacity, jnp.int32),
             )
+        # Chunked admission prefill + cross-request radix prefix cache
+        # (docs/serving.md "Chunked prefill" / "Prefix cache"). Both compose
+        # over the PAGED pool (chunks write pages, the cache shares them):
+        # configuring either on a dense-by-construction engine is a caller
+        # bug, while the PAGED kill-switch forcing dense silently disables
+        # them (a rollback lever must never crash the engine it rolls back).
+        if prefill_chunk_tokens is not None:
+            if kv_page_size is None:
+                raise ValueError("prefill_chunk_tokens requires kv_page_size "
+                                 "(chunks are written page-wise)")
+            if int(prefill_chunk_tokens) < 1:
+                raise ValueError(f"prefill_chunk_tokens must be >= 1, got "
+                                 f"{prefill_chunk_tokens}")
+        if prefix_cache and kv_page_size is None:
+            raise ValueError("prefix_cache requires kv_page_size (the cache "
+                             "shares pool pages)")
+        if max_prefill_slots is not None and max_prefill_slots < 1:
+            raise ValueError(f"max_prefill_slots must be >= 1, got {max_prefill_slots}")
+        self.chunked = (prefill_chunk_tokens is not None and self.paged
+                        and chunked_prefill_enabled())
+        self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
+                                     if self.chunked else None)
+        self.max_prefill_slots = (int(max_prefill_slots)
+                                  if max_prefill_slots is not None else num_slots)
+        self._prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache and self.paged and prefix_cache_enabled():
+            self._prefix_cache = PrefixCache(self._pool, self.kv_page_size)
+        # slot -> in-flight split-prefill task (chunk phase; empty on the
+        # classic one-shot path, where admission completes inside _admit)
+        self._prefilling: Dict[int, _PrefillTask] = {}
+        self._span_chunk = f"{obs_ns}.prefill_chunk"
+        self._span_finish = f"{obs_ns}.prefill_finish"
+        if self.chunked:
+            self.metrics.set_chunked_prefill(self.prefill_chunk_tokens)
+        if self._prefix_cache is not None:
+            self.metrics.set_prefix_cache(self._prefix_cache.stats(), 0)
         # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
         # serving); storing them narrower would silently cast at install
         self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
@@ -562,6 +636,14 @@ class ServingEngine:
             self.watchdog.watch(f"{obs_ns}.quarantine", self._jit_quarantine, budget=1)
             if self._jit_release_pages is not None:
                 self.watchdog.watch(f"{obs_ns}.release_pages", self._jit_release_pages, budget=1)
+            if self._jit_chunk_kv is not None:
+                # chunk programs are keyed on the chunk's covering ladder
+                # bucket; the finish consumes fixed shapes (L queries, the
+                # window's page run) so it owns exactly one program
+                self.watchdog.watch(f"{obs_ns}.prefill_chunk", self._jit_chunk_kv,
+                                    budget=len(self.prefill_buckets))
+                self.watchdog.watch(f"{obs_ns}.prefill_finish",
+                                    self._jit_prefill_finish, budget=1)
 
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
@@ -731,12 +813,50 @@ class ServingEngine:
                 ),
             )
 
+        @partial(jax.jit, donate_argnums=(1,))
+        def chunk_kv(params, cache, ids, offset, count, latent_start, table_row):
+            # one SPLIT-prefill chunk (docs/serving.md "Chunked prefill"):
+            # position-wise KV for prompt tokens [offset, offset + count)
+            # scattered page-wise through table_row — the slot's IN-CACHE
+            # table stays trash until the finish, so interleaved decode
+            # ticks cannot write into the half-built reservation. ids is
+            # padded to a ladder bucket (programs keyed on that shape, <=
+            # one per rung); padded rows write zero payloads to the trash
+            # page (PagedKVCache.write_rows).
+            cb = ids.shape[1]
+            j = jnp.arange(cb)
+            pos = jnp.clip(offset + j, 0, model.max_seq_len - 1)[None, :]
+            latent_mask = ((offset + j) >= latent_start)[None, :]
+            k, v = model.apply(params, ids, pos, latent_mask,
+                               method=type(model).prefill_chunk_kv)
+            return cache.replace(
+                ca=cache.ca.write_rows(table_row, offset, count, k[0], v[0])
+            )
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_finish(params, cache, state, slot, table_row, ids, n, rng,
+                           temperature, top_k, top_p, do_sample, pad_id):
+            # the SPLIT prefill's finish: latents for the last max_latents
+            # prompt tokens against the slot's already-written pages, then
+            # the install bookkeeping (table, ring offset, SA cache, slot
+            # state activation). Fixed shapes throughout — ONE program ever.
+            req_logits, sa_src = model.apply(
+                params, ids, n, cache.ca, table_row,
+                method=type(model).prefill_finish_paged,
+            )
+            cache = cache.install_finish(slot, table_row, sa_src, n)
+            state = _install_state(state, slot, req_logits, rng,
+                                   temperature, top_k, top_p, do_sample, pad_id)
+            return cache, state
+
         self._jit_prefill = prefill_one
         self._jit_install = install_paged if self.paged else install
         self._jit_release = release
         self._jit_release_pages = release_pages if self.paged else None
         self._jit_decode = decode_step
         self._jit_quarantine = quarantine_paged if self.paged else quarantine
+        self._jit_chunk_kv = chunk_kv if self.paged else None
+        self._jit_prefill_finish = prefill_finish if self.paged else None
 
     @property
     def decode_compilations(self) -> int:
@@ -760,6 +880,8 @@ class ServingEngine:
         ]
         if self._jit_release_pages is not None:
             jits.append(self._jit_release_pages)
+        if self._jit_chunk_kv is not None:
+            jits.extend((self._jit_chunk_kv, self._jit_prefill_finish))
         return sum(f._cache_size() for f in jits)
 
     # -------------------------------------------------------------- capacity
@@ -777,11 +899,29 @@ class ServingEngine:
             return self.scheduler.load
         slots = self.scheduler.free_slots
         pages = self._pool.free_pages
+        # prefix-cache accounting (the shared-reservation seam fix,
+        # docs/serving.md "Prefix cache"): a queued request whose prompt
+        # extends a cached prefix will RETAIN those pages, not allocate
+        # them — counting its full reservation would under-admit the very
+        # workload the cache exists for. Cached pages nobody references
+        # (refcount 1) additionally count as available supply: the
+        # admission gate's LRU eviction frees them before backpressure —
+        # minus any a queued request's own match would pin (a page cannot
+        # be both "shared, free of charge" and "evictable supply").
+        reclaim = (set(self._prefix_cache.reclaimable_page_ids())
+                   if self._prefix_cache is not None else set())
+        pages += len(reclaim)
         absorbed = 0
         for request in self.scheduler.queued():
             if slots <= 0:
                 break
             need = self._pages_for(request)
+            if self._prefix_cache is not None and request.page_keys:
+                matched = self._prefix_cache.peek_match_pages(request.page_keys)
+                need -= len(matched)
+                pinned = reclaim.intersection(matched)
+                reclaim -= pinned
+                pages -= len(pinned)  # retained by the hit: no longer supply
             if need > pages:
                 break  # head-of-line: later requests wait behind this one
             slots -= 1
@@ -802,13 +942,38 @@ class ServingEngine:
             )
         return request.pages_reserved
 
+    def _shared_match(self, request: ServedRequest) -> int:
+        """Pages the head request's prompt currently shares with the radix
+        cache (no LRU/hit-rate side effects — accounting only)."""
+        if self._prefix_cache is None or not request.page_keys:
+            return 0
+        return self._prefix_cache.peek_match(request.page_keys)
+
     def _can_admit_paged(self, request: ServedRequest) -> bool:
         """Admission gate for ``SlotScheduler.pop_admissible``: does the free
-        list cover the head request's reservation? A blocked head counts one
+        list cover the head request's reservation — counting pages its
+        prompt shares with the prefix cache ONCE (they are retained, not
+        allocated)? Under pressure, cached-but-unreferenced pages are
+        reclaimed refcount-aware-LRU FIRST (after touching the head's own
+        match so eviction cannot grow the very need being fitted), so a full
+        pool of stale cache yields to live reservations before admission
+        ever reports backpressure. A blocked head counts one
         ``alloc_failure`` per blocking EPISODE (not per tick — a long block
         must not flood the metrics stream) and stays queued — pool exhaustion
         is never a crash and never skips FIFO order."""
-        need = self._pages_for(request)
+        reservation = self._pages_for(request)
+        need = reservation - self._shared_match(request)
+        if not self._pool.can_allocate(need) and self._prefix_cache is not None:
+            self._prefix_cache.touch(request.page_keys or ())
+            freed = self._prefix_cache.evict(need - self._pool.free_pages)
+            if freed:
+                self.metrics.record_prefix_evict(freed, need)
+                self.metrics.set_prefix_cache(
+                    self._prefix_cache.stats(), self._shared_pages_in_use()
+                )
+            # eviction can only SHRINK the match (never grow it), so the
+            # recheck below uses the post-eviction supply and match together
+            need = reservation - self._shared_match(request)
         if self._pool.can_allocate(need):
             if self._alloc_blocked_id == request.request_id:
                 self._alloc_blocked_id = None  # episode over
@@ -819,6 +984,18 @@ class ServingEngine:
             if self._obs_on:
                 self._obs.counter_inc(f"{self._obs_ns}.alloc_failures")
         return False
+
+    def _shared_pages_in_use(self) -> int:
+        """Live page-table entries currently backed by SHARED pages (pool
+        refcount >= 2 counting the cache's own hold) — the v8 gauge that
+        makes 'sessions at fixed HBM' legible from a snapshot."""
+        if self._pool is None:
+            return 0
+        return sum(
+            self._pool.shared_count(pages)
+            for pages in self._slot_pages
+            if pages
+        )
 
     # ------------------------------------------------------------------ submit
     def submit(
@@ -884,6 +1061,22 @@ class ServingEngine:
         )
         if request.deadline_s is not None:
             self._deadlines_seen = True
+        if self._prefix_cache is not None:
+            # cacheable page keys, once per request (serving/paging.py):
+            # the admission gate and engine.load re-walk the queue with them
+            # every tick, so deriving here keeps those walks O(pages).
+            # RING-ROTATION gate: a session whose prompt + generation budget
+            # exceeds the window wraps its ring mid-decode — append writes
+            # land back at position 0, IN ITS OWN OLDEST PAGES. Those pages
+            # must never be shared (a fork would watch its prefix mutate) or
+            # donated (the cache would serve mid-overwrite garbage), so such
+            # a request neither probes nor inserts. Worst-case by
+            # construction, like the page reservation itself: EOS may stop
+            # the wrap from ever happening, but admission cannot know that.
+            if int(prompt.size) + int(config.max_new_tokens) <= self._window:
+                request.page_keys = page_keys_for_prompt(
+                    prompt.tolist(), self.kv_page_size, self._latents
+                )
         self.metrics.record_submit(request.request_id, int(prompt.size),
                                    priority=request.priority)
         if self._obs_on:
@@ -983,9 +1176,23 @@ class ServingEngine:
     def _admit(self, slot: int, request: ServedRequest) -> None:
         cfg = request.config
         t0 = time.perf_counter()
-        bucket = self._bucket_for(request.prompt_ids.size)
+        n = int(request.prompt_ids.size)
+        bucket = self._bucket_for(n)
         pages: Optional[int] = None
         if self.paged:
+            # SPLIT admission (docs/serving.md "Chunked prefill" / "Prefix
+            # cache"): a prompt extending a cached prefix retains those
+            # pages and chunk-prefills only the uncached tail; a long
+            # prompt on a chunked engine spreads its KV writes one chunk
+            # per tick. Everything else takes the classic one-shot path
+            # below, bit-identical to the pre-chunking engine.
+            shared_run: List[int] = []
+            if self._prefix_cache is not None and request.page_keys:
+                shared_run = self._prefix_cache.probe(request.page_keys)
+            if shared_run or (self.chunked and n >= self._latents
+                              and n > self.prefill_chunk_tokens):
+                self._admit_split(slot, request, bucket, shared_run, t0)
+                return
             # the ONLY allocation point (serving/paging.py): the whole
             # reservation — bucket + generation budget — is claimed here, so
             # a running slot can never page-fault. pop_admissible's
@@ -1021,6 +1228,19 @@ class ServingEngine:
                     self._cache, self._state, slot, req_cache, req_logits,
                     request.rng, *sampling,
                 )
+        if self.paged and self._prefix_cache is not None and request.page_keys:
+            # the page-aligned install makes this prompt's pages cache-grade:
+            # insert the cacheable run (full pages below the latent
+            # boundary) so later prompts sharing the prefix fork instead of
+            # recomputing — the donor's pages gain the cache's reference and
+            # outlive this session
+            self._prefix_cache.insert(
+                request.page_keys,
+                [int(p) for p in table_row[: len(request.page_keys)]],
+            )
+            self.metrics.set_prefix_cache(
+                self._prefix_cache.stats(), self._shared_pages_in_use()
+            )
         # NON-BLOCKING: no device sync here — the prefill/install dispatch
         # overlaps the decode stream, and step() syncs once per tick (its
         # np.asarray on the decoded tokens). prefill_s is therefore dispatch
@@ -1051,6 +1271,158 @@ class ServingEngine:
             self._obs.async_instant(self._span_cat, request.request_id, "prefill",
                                     slot=slot, bucket=bucket)
 
+    def _admit_split(self, slot: int, request: ServedRequest, bucket: int,
+                     shared_run: List[int], t0: float) -> None:
+        """Claim the slot and the reservation for a SPLIT admission: shared
+        prefix pages are RETAINED (the O(page-table copy) fork —
+        serving/paging.py), only the remainder is allocated, and a
+        ``_PrefillTask`` drives chunk dispatches across ticks (one chunk per
+        tick with chunking on; straight to the finish otherwise). The
+        request holds its slot from here — RUNNING for every scheduler
+        purpose — but decodes nothing until the finish step activates it."""
+        cfg = request.config
+        n = int(request.prompt_ids.size)
+        reservation = self._pages_for(request)
+        shared = len(shared_run)
+        if shared:
+            self._pool.retain(shared_run)
+        private = self._pool.allocate(reservation - shared)
+        page_ids = list(shared_run) + private
+        self._slot_pages[slot] = page_ids
+        table_row = np.zeros((self._pages_per_slot,), np.int32)
+        table_row[: len(page_ids)] = page_ids  # trash-padded reservation
+        shared_tokens = shared * self.kv_page_size
+        budget = (self.prefill_chunk_tokens if self.chunked
+                  else max(n - shared_tokens, 1))
+        task = _PrefillTask(
+            request=request, table_row=table_row, n=n, bucket=bucket,
+            next_pos=shared_tokens, chunk_budget=budget, shared_pages=shared,
+            t0=t0, resumed=request.status is RequestStatus.PREEMPTED,
+        )
+        self._prefilling[slot] = task
+        request.status = RequestStatus.RUNNING
+        request.slot = slot
+        if self.journal is not None:
+            # "admitted" marks in-flight work the moment the slot is
+            # claimed: a crash mid-chunk recovers this session as a
+            # PREEMPTED continuation (drain finishes it), exactly like a
+            # one-shot admission that died between install and first token
+            self._journal_admits.append(request.request_id)
+        if shared:
+            self.metrics.record_prefix_hit(request.request_id, shared,
+                                           shared_tokens)
+            if self._obs_on:
+                self._obs.counter_inc(f"{self._obs_ns}.prefix_hits")
+        self.metrics.set_page_pool(
+            self._pool.num_pages - self._pool.reserved, self._pool.pages_in_use
+        )
+        # first chunk dispatches THIS tick; with chunking off (a pure
+        # cache-hit fork) the whole tail + finish lands now, single-tick,
+        # like the classic path
+        self._advance_prefill(slot, task)
+        while not self.chunked and slot in self._prefilling:
+            self._advance_prefill(slot, task)
+
+    def _advance_prefill(self, slot: int, task: _PrefillTask) -> None:
+        """Dispatch ONE prefill chunk for a mid-admission slot (step_dispatch
+        calls this once per prefilling slot per tick — the bounded-stall
+        contract: a decode tick never waits on more than one chunk's worth
+        of prefill work per prefilling slot). When the last chunk lands,
+        the finish step runs in the same tick, so the slot starts decoding
+        with no idle tick in between."""
+        request = task.request
+        remaining = task.n - task.next_pos
+        if remaining > 0:
+            c = min(task.chunk_budget, remaining)
+            cb = self._bucket_for(c)  # chunk program shapes ride the ladder
+            ids = np.full((1, cb), request.config.pad_token_id, np.int32)
+            ids[0, :c] = request.prompt_ids[task.next_pos: task.next_pos + c]
+            t0 = time.perf_counter()
+            with self._obs.span(self._span_chunk):
+                self._cache = self._jit_chunk_kv(
+                    self.params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(task.next_pos, jnp.int32),
+                    jnp.asarray(c, jnp.int32),
+                    jnp.asarray(task.n - self._latents, jnp.int32),
+                    jnp.asarray(task.table_row),
+                )
+            task.next_pos += c
+            task.chunks += 1
+            if self.chunked:
+                # chunk events/counters belong to CHUNKED admission only: a
+                # pure cache-hit fork on an unchunked engine rides this same
+                # split path (one tail dispatch) but must not emit a stream
+                # the snapshot's chunked_prefill: None disclaims
+                self.metrics.record_chunk(request.request_id, slot, c,
+                                          time.perf_counter() - t0)
+        if self._prefix_cache is not None and request.page_keys:
+            # INCREMENTAL donor insert: every cacheable page fully covered by
+            # the chunks written so far is final (the wrap gate pins pages
+            # below the latent boundary immutable for this session's whole
+            # lifetime), so it joins the trie NOW — a same-burst sibling
+            # admitted next tick forks the half-prefilled prompt instead of
+            # recomputing it. insert() leaves already-cached nodes (the
+            # shared head this task itself forked) untouched.
+            upto = min(task.next_pos // self.kv_page_size,
+                       len(request.page_keys))
+            if upto:
+                self._prefix_cache.insert(
+                    request.page_keys[:upto],
+                    [int(p) for p in task.table_row[:upto]],
+                )
+        if task.next_pos >= task.n:
+            self._finish_prefill(slot, task)
+
+    def _finish_prefill(self, slot: int, task: _PrefillTask) -> None:
+        """The split admission's FINISH: one fixed-shape program computes the
+        latents against the slot's pages, installs the page table / ring
+        offset / SA cache, and activates the slot's decode state — the
+        moment this request is ADMITTED in the metrics sense (its TTFT
+        includes the chunk phase, honestly)."""
+        request = task.request
+        cfg = request.config
+        ids_latent = np.asarray(
+            request.prompt_ids[task.n - self._latents:], np.int32
+        )[None, :]
+        sampling = (
+            float(cfg.temperature) if cfg.do_sample else 1.0,
+            int(cfg.top_k) if (cfg.do_sample and cfg.top_k) else 0,
+            float(cfg.top_p) if (cfg.do_sample and cfg.top_p is not None) else 1.0,
+            bool(cfg.do_sample),
+            int(cfg.pad_token_id),
+        )
+        with self._obs.span(self._span_finish):
+            self._cache, self._state = self._jit_prefill_finish(
+                self.params, self._cache, self._state, slot,
+                jnp.asarray(task.table_row), jnp.asarray(ids_latent),
+                jnp.asarray(task.n, jnp.int32), request.rng, *sampling,
+            )
+        del self._prefilling[slot]
+        # (donor insert already happened incrementally, chunk by chunk, in
+        # _advance_prefill — by the last chunk it covered every cacheable key)
+        now = time.perf_counter()
+        request.pages_allocated = len(self._slot_pages[slot] or [])
+        if request.replay_ids is not None and request.replay_pos < request.replay_ids.size:
+            self._replay_slots[slot] = request
+        request.admitted_at = now
+        self.metrics.record_admit(
+            request.request_id, slot, wait_s=task.t0 - request.enqueued_at,
+            prefill_s=now - task.t0, bucket=task.bucket,
+            pages=request.pages_allocated, priority=request.priority,
+            preempted_replay=task.resumed,
+            chunks=task.chunks if self.chunked else None,
+            shared_pages=task.shared_pages or None,
+        )
+        if self._prefix_cache is not None:
+            self.metrics.set_prefix_cache(
+                self._prefix_cache.stats(), self._shared_pages_in_use()
+            )
+        if self._obs_on:
+            self._obs.async_instant(self._span_cat, request.request_id,
+                                    "prefill", slot=slot, bucket=task.bucket,
+                                    chunks=task.chunks,
+                                    shared_pages=task.shared_pages)
+
     def _evict(
         self, slot: int, request: ServedRequest, reason: str,
         status: RequestStatus = RequestStatus.FINISHED,
@@ -1058,12 +1430,15 @@ class ServingEngine:
     ) -> None:
         self.scheduler.release(slot)
         self._replay_slots.pop(slot, None)
+        self._prefilling.pop(slot, None)  # a mid-chunk admission dies whole
         self._state = self._jit_release(self._state, slot)
         if self.paged:
             # paged eviction: reset the slot's table to the trash page on
             # device (a freed slot keeps decoding — stale entries would
             # corrupt reallocated pages) and return the ids to the free
-            # list. No O(window) row zeroing — that is the point.
+            # list. No O(window) row zeroing — that is the point. A SHARED
+            # page's release only drops this slot's reference: the prefix
+            # cache and any sibling sessions keep theirs (serving/paging.py).
             self._cache = self._jit_release_pages(self._cache, slot)
             pages = self._slot_pages[slot]
             if pages:
@@ -1160,7 +1535,12 @@ class ServingEngine:
         need_slot = self.scheduler.free_slots == 0
         need_pages = 0
         if self.paged:
-            need_pages = self._pages_for(request) - self._pool.free_pages
+            # shared-reservation accounting (the prefix-cache seam fix): a
+            # head whose prompt extends a cached prefix RETAINS those pages,
+            # so only the uncovered remainder needs freeing — preempting for
+            # pages the cache already supplies would burn replays for nothing
+            need_pages = (self._pages_for(request) - self._shared_match(request)
+                          - self._pool.free_pages)
         if not need_slot and need_pages <= 0:
             return []  # the head is not resource-blocked: nothing to free
         candidates = [
@@ -1172,15 +1552,40 @@ class ServingEngine:
             -(len(self._slot_pages[sr[0]]) if self.paged and self._slot_pages[sr[0]] else 0),
             -sr[1].request_id,
         ))
-        chosen, freed_pages, freed_slots = [], 0, 0
+
+        # what a victim set ACTUALLY frees for the head: releasing a shared
+        # page only drops a refcount (PagePool.release), so raw page-list
+        # lengths overcount under prefix sharing — preempting a fork whose
+        # pages a live sibling still holds would burn its replay without
+        # unblocking anything. A page counts IFF, after every chosen victim
+        # releases, it reaches refcount 0 (returns to the free list now) or
+        # refcount 1 with the cache the only holder left (the admission
+        # gate's refcount-aware LRU reclaims it before reporting
+        # backpressure). Dense engines: every page is refcount 1, so this
+        # degrades to the plain page-list length — the pre-cache behavior.
+        cached = (self._prefix_cache.cached_page_ids()
+                  if self._prefix_cache is not None else frozenset())
+
+        def sim_freed(victims) -> int:
+            if not self.paged:
+                return 0
+            drops: Dict[int, int] = {}
+            for slot, _r in victims:
+                for p in self._slot_pages[slot] or []:
+                    drops[p] = drops.get(p, 0) + 1
+            return sum(
+                1 for p, d in drops.items()
+                if (rc := self._pool.refcount(p) - d) == 0
+                or (rc == 1 and p in cached)
+            )
+
+        chosen, freed_slots = [], 0
         for slot, r in candidates:
-            if freed_pages >= need_pages and freed_slots >= (1 if need_slot else 0):
+            if sim_freed(chosen) >= need_pages and freed_slots >= (1 if need_slot else 0):
                 break
             chosen.append((slot, r))
-            if self.paged and self._slot_pages[slot]:
-                freed_pages += len(self._slot_pages[slot])
             freed_slots += 1
-        if freed_pages < need_pages or (need_slot and freed_slots < 1):
+        if sim_freed(chosen) < need_pages or (need_slot and freed_slots < 1):
             return []
         # minimization pass: the cross-class greedy can pick a cheap
         # low-class victim that a later, larger victim then makes redundant
@@ -1190,12 +1595,10 @@ class ServingEngine:
         # same deterministic selection order, every victim whose contribution
         # is no longer needed for coverage.
         for slot, r in list(chosen):
-            pages = (len(self._slot_pages[slot])
-                     if self.paged and self._slot_pages[slot] else 0)
-            if (freed_pages - pages >= need_pages
-                    and (not need_slot or freed_slots - 1 >= 1)):
-                chosen.remove((slot, r))
-                freed_pages -= pages
+            trial = [v for v in chosen if v[0] != slot]
+            if (sim_freed(trial) >= need_pages
+                    and (not need_slot or len(trial) >= 1)):
+                chosen = trial
                 freed_slots -= 1
         return chosen
 
@@ -1209,6 +1612,10 @@ class ServingEngine:
         failover mechanism, reused intra-engine)."""
         self.scheduler.release(slot)
         self._replay_slots.pop(slot, None)
+        # a victim preempted MID-SPLIT-PREFILL loses the half-built chunk
+        # work (no tokens were emitted, so nothing is owed): its task dies
+        # here and the re-admission chunk-prefills from scratch
+        self._prefilling.pop(slot, None)
         self._state = self._jit_release(self._state, slot)
         pages_freed = 0
         if self.paged:
@@ -1261,13 +1668,23 @@ class ServingEngine:
             head = self.scheduler.peek()
             if head is None:
                 return
+            # same chunk-aware bound as the first pass: admission via
+            # preemption must not schedule more concurrent chunk streams
+            # than max_prefill_slots allows either — the bounded-stall
+            # contract has no priority exemption. Checked BEFORE victim
+            # selection: an exhausted chunk budget must not burn replays
+            # for an admission that cannot happen this tick.
+            limit = (max(self.max_prefill_slots - len(self._prefilling), 0)
+                     if self.chunked else None)
+            if limit == 0:
+                return
             victims = self._select_victims(head)
             if not victims:
                 return
             for slot, victim in victims:
                 self._preempt(slot, victim, preemptor=head)
             admitted = False
-            for slot, request in self.scheduler.pop_admissible(can_admit):
+            for slot, request in self.scheduler.pop_admissible(can_admit, limit=limit):
                 self._admit(slot, request)
                 admitted = True
             if not admitted:
@@ -1510,6 +1927,17 @@ class ServingEngine:
             self.scheduler.advance_tick()  # the priority-aging clock (int add)
             if self._deadlines_seen:
                 self._expire_deadlines(time.perf_counter())
+            # chunked prefill's interleave (docs/serving.md "Chunked
+            # prefill"): slots mid-split-admission advance ONE chunk per
+            # tick, BEFORE new admissions — oldest work first, and a finish
+            # here frees prefill-slot budget the admission pass below can
+            # hand out. Snapshotted so a task enqueued by this tick's own
+            # admissions (which dispatch their first chunk inside
+            # _admit_split) never advances twice in one tick.
+            if self._prefilling:
+                for slot, task in list(self._prefilling.items()):
+                    if self._prefilling.get(slot) is task:
+                        self._advance_prefill(slot, task)
             if not self._draining or self.scheduler.queue_depth:
                 # while draining, the queue can only hold PREEMPTED
                 # continuations (fresh submits are refused and _begin_drain
@@ -1519,7 +1947,13 @@ class ServingEngine:
                 # contract covers a victim parked by preemption
                 with self._obs.span(self._span_admit):
                     can_admit = self._can_admit_paged if self.paged else None
-                    for slot, request in self.scheduler.pop_admissible(can_admit):
+                    # chunk-aware admission bound: a chunked engine schedules
+                    # at most max_prefill_slots concurrent chunk streams, so
+                    # per-tick prefill work stays bounded at (budget x chunk)
+                    # no matter how deep the queue is
+                    limit = (max(self.max_prefill_slots - len(self._prefilling), 0)
+                             if self.chunked else None)
+                    for slot, request in self.scheduler.pop_admissible(can_admit, limit=limit):
                         self._admit(slot, request)
                     if self.priority_preemption and not self._draining:
                         # second pass: a higher-class head blocked on
@@ -1533,6 +1967,11 @@ class ServingEngine:
                 self._obs.gauge_set(f"{self._obs_ns}.queue_depth", self.scheduler.queue_depth)
                 if self.paged:
                     self._obs.gauge_set(f"{self._obs_ns}.pages_in_use", self._pool.pages_in_use)
+            # slots mid-split-prefill hold no decode state yet (their
+            # SlotState row is inactive, their in-cache table trash): they
+            # are claimed for every scheduler purpose but must not be
+            # harvested — the decode step would hand them pad tokens
+            occupied = [(s, r) for s, r in occupied if s not in self._prefilling]
             if not occupied:
                 self._obs.span_end(self._span_tick)
                 return False
@@ -1613,6 +2052,32 @@ class ServingEngine:
                     if self.paged:
                         row = np.zeros((self._pages_per_slot,), np.int32)
                         pages = self._slot_pages[slot] or []
+                        if self._prefix_cache is not None:
+                            # invalidate the cache subtree reached through
+                            # this slot's prefix FIRST, so the possibly-
+                            # tainted run is never served again — and so a
+                            # poisoned page the CACHE alone shared drops to
+                            # refcount 1 here and is zeroed below before its
+                            # release returns it to the free list (filtering
+                            # before invalidating would let it back into the
+                            # pool with the NaN bytes intact). Pages sibling
+                            # forks still hold (refcount >= 2 after the
+                            # invalidation) must not be zeroed — that would
+                            # corrupt a healthy sibling's prefix mid-decode;
+                            # they route to the trash entry instead, and the
+                            # siblings keep their own containment
+                            # (docs/serving.md).
+                            if request.page_keys:
+                                dropped = self._prefix_cache.invalidate(
+                                    request.page_keys
+                                )
+                                if dropped:
+                                    self.metrics.set_prefix_cache(
+                                        self._prefix_cache.stats(),
+                                        self._shared_pages_in_use(),
+                                    )
+                            pages = [p for p in pages
+                                     if self._pool.refcount(p) < 2]
                         row[: len(pages)] = pages
                         self._cache = self._jit_quarantine(
                             self._cache, slot, jnp.asarray(row)

@@ -75,6 +75,23 @@ Schema history:
     ``recovery`` event (sessions recovered, replayed tokens, torn-tail
     truncation stats) emitted by ``ServingEngine.recover``. The reader
     normalizes pre-v7 snapshots with ``None``.
+  * ``serving-metrics/v8`` — the chunked-prefill + prefix-cache schema
+    (docs/serving.md "Chunked prefill" / "Prefix cache"): every snapshot
+    carries a ``prefix_cache`` field — ``None`` on engines without the
+    radix cache (and on router snapshots — caches are per-replica, the
+    replica sections carry the real gauges), else ``hits`` / ``misses`` /
+    ``hit_rate`` / ``cached_pages`` / ``shared_pages_in_use`` /
+    ``inserted_pages`` / ``evicted_pages`` / ``evictions`` — and a
+    ``chunked_prefill`` field — ``None`` unless the engine runs chunked
+    admission, else ``chunk_tokens`` / ``chunks_dispatched`` /
+    ``chunked_admissions``. The stream gains ``prefix_hit`` events (shared
+    pages + tokens a new request reused), ``prefix_evict`` events
+    (refcount-aware LRU reclaims under pool pressure), and ``chunk`` events
+    (one per dispatched prefill chunk); ``admit`` events gain ``chunks``
+    and ``shared_pages`` fields on chunked/shared admissions. The reader
+    normalizes pre-v8 snapshots with ``None`` for both sections — "not
+    recorded" stays distinguishable from "feature off", the v2→v3
+    discipline throughout.
 """
 
 from __future__ import annotations
@@ -87,7 +104,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v7"
+SCHEMA = "serving-metrics/v8"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -96,13 +113,16 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v5",
     "serving-metrics/v6",
     "serving-metrics/v7",
+    "serving-metrics/v8",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
 _V6_FIELDS = ("preemptions", "preempted_replays", "queue_wait_by_priority")
+_V8_FIELDS = ("prefix_cache", "chunked_prefill")
 _PRE_V5 = KNOWN_SCHEMAS[:4]
 _PRE_V6 = KNOWN_SCHEMAS[:5]
 _PRE_V7 = KNOWN_SCHEMAS[:6]
+_PRE_V8 = KNOWN_SCHEMAS[:7]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -186,6 +206,12 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # pre-v7 writers had no request journal; None also matches a
                 # newer engine's truthful "no journal configured"
                 snap.setdefault("journal", None)
+            if schema in _PRE_V8:
+                # pre-v8 writers had neither a prefix cache nor chunked
+                # prefill: None, NOT 0 — "not recorded" must stay
+                # distinguishable from "feature off / nothing happened"
+                for k in _V8_FIELDS:
+                    snap.setdefault(k, None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -279,6 +305,15 @@ class EngineMetrics(_JsonlMetrics):
     # write-ahead journal gauges (serving-metrics/v7): None <=> the engine
     # runs without a journal and snapshots report journal: None
     journal: Optional[Dict] = None
+    # prefix-cache gauges (serving-metrics/v8): None <=> no radix cache
+    # configured; the engine mirrors PrefixCache.stats() here per tick,
+    # plus the live shared-page gauge
+    prefix_cache: Optional[Dict] = None
+    # chunked-prefill counters (serving-metrics/v8): chunk_tokens None <=>
+    # chunked admission off and snapshots report chunked_prefill: None
+    chunk_tokens: Optional[int] = None
+    chunks_dispatched: int = 0
+    chunked_admissions: int = 0
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
     _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -303,6 +338,7 @@ class EngineMetrics(_JsonlMetrics):
         self, request_id: int, slot: int, wait_s: float, prefill_s: float,
         bucket: Optional[int] = None, pages: Optional[int] = None,
         priority: int = 0, preempted_replay: bool = False,
+        chunks: Optional[int] = None, shared_pages: Optional[int] = None,
     ) -> None:
         self.requests_admitted += 1
         self.prefills += 1
@@ -322,9 +358,51 @@ class EngineMetrics(_JsonlMetrics):
         if preempted_replay:  # a preempted continuation re-admitted as replay
             self.preempted_replays += 1
             extra["preempted_replay"] = True
+        if chunks is not None:  # v8: a chunk-phased admission's planned chunks
+            self.chunked_admissions += 1
+            extra["chunks"] = chunks
+        if shared_pages:  # v8: prefix-cache pages this admission reused
+            extra["shared_pages"] = shared_pages
         self._emit("admit", request_id=request_id, slot=slot,
                    wait_s=round(wait_s, 6), prefill_s=round(prefill_s, 6),
                    priority=priority, **extra)
+
+    def record_chunk(self, request_id: int, slot: int, tokens: int,
+                     seconds: float) -> None:
+        """One dispatched prefill chunk (serving-metrics/v8): ``tokens`` real
+        prompt tokens whose KV rows this tick's chunk program wrote;
+        ``seconds`` is DISPATCH time (non-blocking, like prefill_s)."""
+        self.chunks_dispatched += 1
+        self._emit("chunk", request_id=request_id, slot=slot, tokens=tokens,
+                   seconds=round(seconds, 6))
+
+    def record_prefix_hit(self, request_id: int, shared_pages: int,
+                          shared_tokens: int) -> None:
+        """One prefix-cache HIT at admission (serving-metrics/v8): the new
+        request retained ``shared_pages`` cached pages covering
+        ``shared_tokens`` prompt tokens — KV it neither recomputes nor
+        re-stores."""
+        self._emit("prefix_hit", request_id=request_id,
+                   shared_pages=shared_pages, shared_tokens=shared_tokens)
+
+    def record_prefix_evict(self, pages_freed: int, pages_needed: int) -> None:
+        """One refcount-aware LRU eviction episode under pool pressure
+        (serving-metrics/v8): cached-but-unreferenced pages yielded to a live
+        reservation before admission saw queue_full."""
+        self._emit("prefix_evict", pages_freed=pages_freed,
+                   pages_needed=pages_needed)
+
+    def set_prefix_cache(self, stats: Dict, shared_pages_in_use: int) -> None:
+        """Refresh the v8 prefix-cache gauges (the engine hands in
+        ``PrefixCache.stats()`` plus the live count of table entries
+        currently backed by shared pages)."""
+        self.prefix_cache = dict(stats)
+        self.prefix_cache["shared_pages_in_use"] = shared_pages_in_use
+
+    def set_chunked_prefill(self, chunk_tokens: int) -> None:
+        """Mark chunked admission active (serving-metrics/v8): snapshots
+        report the chunked_prefill section instead of None."""
+        self.chunk_tokens = chunk_tokens
 
     def record_preempt(self, request_id: int, slot: int, preempted_by: int,
                        pages_freed: int, emitted_tokens: int,
@@ -486,6 +564,15 @@ class EngineMetrics(_JsonlMetrics):
             # v7: None without a write-ahead journal (same reading as a
             # pre-v7 snapshot), the live gauge block otherwise
             "journal": None if self.journal is None else dict(self.journal),
+            # v8: None without a radix prefix cache / without chunked
+            # admission (same reading as a pre-v8 snapshot), live otherwise
+            "prefix_cache": None if self.prefix_cache is None
+            else dict(self.prefix_cache),
+            "chunked_prefill": None if self.chunk_tokens is None else {
+                "chunk_tokens": self.chunk_tokens,
+                "chunks_dispatched": self.chunks_dispatched,
+                "chunked_admissions": self.chunked_admissions,
+            },
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -609,11 +696,13 @@ class RouterMetrics(_JsonlMetrics):
                 s.get("preempted_replays") or 0 for s in replicas.values()
             ),
             "queue_wait_by_priority": None,
-            # pools and journals are per-engine: the embedded replica
-            # sections carry the real gauges, the router itself truthfully
-            # has neither
+            # pools, journals, prefix caches, and chunked admission are
+            # per-engine: the embedded replica sections carry the real
+            # gauges, the router itself truthfully has none of them
             "page_pool": None,
             "journal": None,
+            "prefix_cache": None,
+            "chunked_prefill": None,
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),
             "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,

@@ -70,7 +70,7 @@ Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v7``:
+phase tables from the single trace. Metrics are ``serving-metrics/v8``:
 router snapshots embed per-replica engine snapshots, the
 failover/shed/breaker counters, and the aggregated preemption counters
 (request ``priority`` is forwarded to engines; engine-local preemption under
@@ -264,6 +264,9 @@ class ServingRouter:
         default_deadline_s: Optional[float] = None,
         kv_page_size: Optional[int] = None,
         num_kv_pages: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: bool = False,
+        max_prefill_slots: Optional[int] = None,
         priority_aging_ticks: Optional[int] = None,
         max_preemptions: int = 2,
         journal: Optional[str] = None,
@@ -344,6 +347,17 @@ class ServingRouter:
                     # exactly the victim's page count (pinned, test_router)
                     kv_page_size=kv_page_size,
                     num_kv_pages=num_kv_pages,
+                    # chunked admission + radix prefix cache are PER-REPLICA
+                    # (docs/serving.md "Prefix cache"): each engine's trie
+                    # shares pages of its own pool, so a failover replay
+                    # lands on the new replica's cache — cold or warm, the
+                    # continuation is token-identical either way (the cache
+                    # only changes where KV comes from, never its values);
+                    # recovered sessions likewise re-resolve their replica's
+                    # fresh cache cold
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                    prefix_cache=prefix_cache,
+                    max_prefill_slots=max_prefill_slots,
                     # priority/preemption policy is per-engine (each replica
                     # preempts over its own slots and pool); the router only
                     # forwards classes and reads the aggregated counters
@@ -1065,7 +1079,7 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v7 router snapshot with per-replica sections."""
+        """serving-metrics/v8 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:

@@ -198,7 +198,11 @@ class SlotScheduler(Generic[T]):
             self._queue = kept
         return removed
 
-    def pop_admissible(self, can_admit: Optional[Callable[[T], bool]] = None) -> Iterator[Tuple[int, T]]:
+    def pop_admissible(
+        self,
+        can_admit: Optional[Callable[[T], bool]] = None,
+        limit: Optional[int] = None,
+    ) -> Iterator[Tuple[int, T]]:
         """Yield (slot, request) admissions in admission order until slots or
         queue run out. The slot is claimed as soon as the pair is yielded, so
         the engine can interleave prefill/install work with further
@@ -211,8 +215,19 @@ class SlotScheduler(Generic[T]):
         order's fairness and make page-allocation order depend on queue
         composition rather than history (determinism contract,
         serving/paging.py). A head blocked on resources is the engine's cue
-        to preempt (serving/engine.py)."""
+        to preempt (serving/engine.py).
+
+        ``limit`` caps admissions THIS call (None = unlimited, the classic
+        behavior): the chunk-aware accounting — a chunked-prefill engine
+        admits at most its remaining prefill-slot budget per tick, so a
+        burst of long prompts cannot schedule more concurrent chunk streams
+        than ``max_prefill_slots`` allows and the per-tick prefill work
+        stays bounded at (budget x chunk) regardless of queue depth
+        (serving/engine.py, docs/serving.md "Chunked prefill")."""
+        admitted = 0
         while self._queue and self._free:
+            if limit is not None and admitted >= limit:
+                return
             head = min(self._queue, key=self._order_key)
             if can_admit is not None and not can_admit(head.request):
                 return
@@ -220,6 +235,7 @@ class SlotScheduler(Generic[T]):
             self._queue.remove(head)
             self._slots[slot] = head.request
             self.total_admissions += 1
+            admitted += 1
             yield slot, head.request
 
     def release(self, slot: int) -> T:

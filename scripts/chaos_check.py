@@ -57,6 +57,20 @@ exercised on every change, not just when production finds them:
                            compaction (before/after the atomic generation
                            rename) loses nothing — whichever generation is
                            durable recovers identically
+  * ``prefix_fork_churn``  shared-prefix sessions fork the radix prefix
+                           cache's pages under pool pressure — admitted,
+                           preempted, resumed, and cache-evicted in one run;
+                           every survivor is f64 token-identical to an
+                           UNCACHED uncontended run, repeat runs pin
+                           statuses/tokens/victim identity, and the drain
+                           leaves the free list whole (no page leaked)
+  * ``chunked_prefill_recovery`` a REAL child serving process SIGKILLed
+                           while a window-length prompt is still MID
+                           chunked-prefill; a fresh process recovers the
+                           half-prefilled session from its journaled accept
+                           alone, f64 token-identical to an uninterrupted
+                           dense run (scripts/journal_crash_harness.py
+                           --chunked)
 
 Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
 
@@ -559,15 +573,9 @@ def check_preempt_disabled_inert() -> dict:
     }
 
 
-def check_journal_crash_restart() -> dict:
-    """Process death is survivable (docs/serving.md "Request journal"): a
-    REAL child serving process is SIGKILLed mid-tick and a fresh process
-    recovers from the write-ahead journal — every accepted request (greedy
-    AND sampled) completes with output f64 token-identical to an
-    uninterrupted run, and replay compiles zero programs beyond the standard
-    set. Run twice into fresh directories: the recovered outputs are pinned
-    to the same deterministic reference both times, whatever tick the kill
-    actually landed on."""
+def _load_crash_harness():
+    """Import scripts/journal_crash_harness.py as a module (scripts/ is not
+    a package)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -577,6 +585,19 @@ def check_journal_crash_restart() -> dict:
     )
     harness = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(harness)
+    return harness
+
+
+def check_journal_crash_restart() -> dict:
+    """Process death is survivable (docs/serving.md "Request journal"): a
+    REAL child serving process is SIGKILLed mid-tick and a fresh process
+    recovers from the write-ahead journal — every accepted request (greedy
+    AND sampled) completes with output f64 token-identical to an
+    uninterrupted run, and replay compiles zero programs beyond the standard
+    set. Run twice into fresh directories: the recovered outputs are pinned
+    to the same deterministic reference both times, whatever tick the kill
+    actually landed on."""
+    harness = _load_crash_harness()
 
     runs, shared = [], None
     # the harness enables x64 (its reference/recovery math is f64); the
@@ -743,6 +764,122 @@ def check_journal_compaction_crash() -> dict:
     }
 
 
+def check_prefix_fork_churn() -> dict:
+    """Shared-prefix sessions fork the radix prefix cache's pages under pool
+    pressure (docs/serving.md "Prefix cache"): a donor warms the cache, two
+    forks saturate the pool, a high-priority fork admits via PREEMPTION of a
+    fork-holder, the victim resumes, and distinct dense traffic then forces
+    refcount-aware cache eviction instead of backpressure. Every request
+    finishes f64 token-identical to an UNCACHED uncontended run, repeat runs
+    pin statuses/tokens/victim identity, and after the drain the pool's free
+    list is whole — the only references left are the cache's own, and
+    clearing it returns the pool to empty (no page leaked)."""
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        # preamble of 9: prompts are n=10, latent boundary 4 -> the first 2
+        # full pages ([7,7],[7,7]) are the shared cacheable run
+        preamble = [7] * 9
+        shared_prompts = [preamble + [t] for t in (1, 2, 3, 4)]
+        dense_prompts = [list(range(13, 24)), list(range(30, 41))]
+
+        def reference():
+            # uncached, uncontended: ample default pool, cache off
+            engine = _engine(model, params, num_slots=3, kv_page_size=2)
+            handles = [engine.submit(p, max_new_tokens=2) for p in shared_prompts]
+            handles += [engine.submit(p, max_new_tokens=1) for p in dense_prompts]
+            engine.run_until_drained(max_steps=300)
+            assert all(h.ok for h in handles)
+            return [h.result().tolist() for h in handles]
+
+        def churn():
+            # page 2 over the 12-token window: each shared request reserves 6
+            # pages, 2 of them shared on a hit; 11 pages (10 allocatable) =
+            # the cached run (2) + exactly two private remainders (4 + 4)
+            engine = _engine(model, params, num_slots=3, kv_page_size=2,
+                             num_kv_pages=11, prefix_cache=True)
+            donor = engine.submit(shared_prompts[0], max_new_tokens=2)
+            engine.run_until_drained(max_steps=300)  # warm: 2 pages cached
+            bg = [engine.submit(p, max_new_tokens=2) for p in shared_prompts[1:3]]
+            engine.step()  # both forks running, pool saturated
+            hi = engine.submit(shared_prompts[3], max_new_tokens=2, priority=2)
+            engine.step()  # page-blocked head preempts the cheapest fork
+            victims = [i for i, h in enumerate(bg) if h.preemptions > 0]
+            hi_via_preemption = hi.status.value == "running" and bool(victims)
+            engine.run_until_drained(max_steps=400)  # victim resumes, finishes
+            # eviction leg: concurrent dense reservations outgrow what is
+            # free; the stale cached run must yield, not backpressure
+            dense = [engine.submit(p, max_new_tokens=1) for p in dense_prompts]
+            engine.run_until_drained(max_steps=300)
+            handles = [donor] + bg + [hi] + dense
+            snap = engine.metrics.snapshot()
+            stats = snap["prefix_cache"]
+            free_list_whole = (engine._pool.pages_in_use
+                               == engine._prefix_cache.cached_pages)
+            cleared = engine._prefix_cache.clear()
+            free_list_whole = free_list_whole and engine._pool.pages_in_use == 0
+            engine.close()
+            return {
+                "statuses": [h.status.value for h in handles],
+                "tokens": [h.result().tolist() for h in handles],
+                "victims": victims,
+                "hi_admitted_via_preemption": hi_via_preemption,
+                "hits": stats["hits"],
+                "evictions": stats["evictions"],
+                "preemptions": snap["preemptions"],
+                "free_list_whole": free_list_whole,
+                "cleared_pages": cleared,
+            }
+
+        expected = reference()
+        r1, r2 = churn(), churn()
+
+    survivors_identical = r1["tokens"] == expected
+    return {
+        "ok": (
+            all(s == "finished" for s in r1["statuses"])
+            and survivors_identical
+            and r1 == r2
+            and r1["hi_admitted_via_preemption"]
+            and len(r1["victims"]) == 1
+            and r1["hits"] >= 3
+            and r1["evictions"] >= 1
+            and r1["free_list_whole"]
+        ),
+        "survivors_identical_to_uncached": survivors_identical,
+        "deterministic_repeat": r1 == r2,
+        "victims": r1["victims"],
+        "hits": r1["hits"],
+        "evictions": r1["evictions"],
+        "preemptions": r1["preemptions"],
+        "free_list_whole": r1["free_list_whole"],
+    }
+
+
+def check_chunked_prefill_recovery() -> dict:
+    """A REAL child serving process running the paged + chunked-prefill
+    engine is SIGKILLed while a window-length prompt is still MID
+    chunked-prefill (the parent aims its kill at a tick whose progress file
+    reports an in-flight split admission): a fresh process recovers every
+    accepted request from the write-ahead journal — the half-prefilled
+    session restarts from its journaled accept alone (chunk installs are
+    device state, not journal state) and completes f64 token-identical to an
+    uninterrupted PLAIN dense run, with decode still ONE compiled program."""
+    harness = _load_crash_harness()
+    with _x64():
+        d = tempfile.mkdtemp(prefix="chaos-chunked-prefill-")
+        try:
+            result = harness.run_crash_restart(d, chunked=True)
+            result.pop("_shared")
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return {
+        "ok": result["ok"],
+        **{k: result[k] for k in ("sessions_recovered", "outputs_identical",
+                                  "all_finished", "decode_compilations",
+                                  "ticks_at_kill", "prefilling_at_kill")},
+    }
+
+
 def check_router_crash_failover() -> dict:
     """A replica crashed mid-decode loses nothing: the victim finishes
     token-identical (f64) to the fault-free run after failover, the survivor
@@ -905,6 +1042,8 @@ CHECKS = {
     "journal_crash_restart": check_journal_crash_restart,
     "journal_torn_tail": check_journal_torn_tail,
     "journal_compaction_crash": check_journal_compaction_crash,
+    "prefix_fork_churn": check_prefix_fork_churn,
+    "chunked_prefill_recovery": check_chunked_prefill_recovery,
     "router_crash_failover": check_router_crash_failover,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,

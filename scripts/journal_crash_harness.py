@@ -52,6 +52,19 @@ WORKLOAD = (
 NUM_SLOTS = 2
 TICK_SLEEP_S = 0.05
 
+# chunked variant (the ``chunked_prefill_recovery`` chaos scenario): a
+# window-length prompt whose SPLIT admission spreads one 2-token chunk per
+# tick (12 tokens -> ~6 chunk ticks), so the parent's kill reliably lands
+# MID-chunked-prefill — the half-prefilled session must recover
+# token-identically from its journaled accept alone (chunk writes are
+# device state; the journal records requests, not pages)
+CHUNKED_WORKLOAD = (
+    (list(range(10, 22)), 8, False, 0),  # window-length: the chunked admission
+    ([4, 5], 8, True, 7),
+    ([6, 7, 8], 8, False, 3),
+)
+CHUNKED_ENGINE_KW = {"kv_page_size": 2, "prefill_chunk_tokens": 2}
+
 
 def build_model():
     """The chaos-suite tiny model in float64 with a fixed init seed — parent
@@ -77,23 +90,26 @@ def build_model():
     return model, params
 
 
-def _submit_workload(engine):
+def _submit_workload(engine, workload=WORKLOAD):
     import jax
 
     return [
         engine.submit(prompt, max_new_tokens=max_new, do_sample=sample,
                       temperature=0.9 if sample else 1.0,
                       rng=jax.random.PRNGKey(seed))
-        for prompt, max_new, sample, seed in WORKLOAD
+        for prompt, max_new, sample, seed in workload
     ]
 
 
-def reference_outputs(model, params):
-    """The uninterrupted run every recovery is pinned against."""
+def reference_outputs(model, params, workload=WORKLOAD):
+    """The uninterrupted run every recovery is pinned against — the PLAIN
+    (dense, one-shot-prefill) engine: chunked/paged parity with it is
+    pinned separately (tests/test_prefix_cache.py), so recovery identity
+    against this reference proves the whole composition."""
     from perceiver_io_tpu.serving import ServingEngine
 
     engine = ServingEngine(model, params, num_slots=NUM_SLOTS)
-    handles = _submit_workload(engine)
+    handles = _submit_workload(engine, workload)
     engine.run_until_drained(max_steps=300)
     assert all(h.ok for h in handles)
     return [h.result().tolist() for h in handles]
@@ -106,19 +122,25 @@ def _write_progress(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
-def serve(journal_dir: str, progress: str) -> None:
-    """Child mode: journaled serving loop, slow-ticked, killed externally."""
+def serve(journal_dir: str, progress: str, chunked: bool = False) -> None:
+    """Child mode: journaled serving loop, slow-ticked, killed externally.
+    ``chunked`` runs the paged + chunked-prefill engine on the
+    window-length workload; each tick's progress reports whether a split
+    admission is still mid-chunk, so the parent can aim its kill there."""
     model, params = build_model()
     from perceiver_io_tpu.serving import ServingEngine
 
+    kw = dict(CHUNKED_ENGINE_KW) if chunked else {}
     engine = ServingEngine(model, params, num_slots=NUM_SLOTS,
-                           journal=journal_dir)
-    handles = _submit_workload(engine)
-    _write_progress(progress, {"accepted": len(handles), "ticks": 0})
+                           journal=journal_dir, **kw)
+    handles = _submit_workload(engine, CHUNKED_WORKLOAD if chunked else WORKLOAD)
+    _write_progress(progress, {"accepted": len(handles), "ticks": 0,
+                               "prefilling": 0})
     ticks = 0
     while engine.step():
         ticks += 1
-        _write_progress(progress, {"accepted": len(handles), "ticks": ticks})
+        _write_progress(progress, {"accepted": len(handles), "ticks": ticks,
+                                   "prefilling": len(engine._prefilling)})
         time.sleep(TICK_SLEEP_S)  # the parent's kill window
     engine.close()
     _write_progress(progress, {"accepted": len(handles), "ticks": ticks,
@@ -127,9 +149,13 @@ def serve(journal_dir: str, progress: str) -> None:
 
 
 def spawn_and_kill(journal_dir: str, progress: str,
-                   kill_after_ticks: int = 2, timeout_s: float = 120.0) -> dict:
+                   kill_after_ticks: int = 2, timeout_s: float = 120.0,
+                   chunked: bool = False,
+                   require_prefilling: bool = False) -> dict:
     """Run a child serving process and SIGKILL it once it has accepted the
-    workload and decoded ``kill_after_ticks`` ticks. Returns what the parent
+    workload and decoded ``kill_after_ticks`` ticks — with
+    ``require_prefilling``, only while a split admission is still mid-chunk
+    (the chunked_prefill_recovery kill point). Returns what the parent
     observed (ticks at kill, whether the child finished early — callers
     treat early completion as a failed kill window)."""
     env = dict(os.environ)
@@ -138,7 +164,8 @@ def spawn_and_kill(journal_dir: str, progress: str,
         os.remove(progress)
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "serve",
-         "--journal-dir", journal_dir, "--progress", progress],
+         "--journal-dir", journal_dir, "--progress", progress]
+        + (["--chunked"] if chunked else []),
         env=env, cwd=_REPO,
         stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
     )
@@ -158,7 +185,9 @@ def spawn_and_kill(journal_dir: str, progress: str,
                         seen = json.load(f)
                 except (OSError, ValueError):
                     seen = {}  # racing the atomic replace: retry next poll
-                if seen.get("ticks", -1) >= kill_after_ticks:
+                if seen.get("ticks", -1) >= kill_after_ticks and (
+                    not require_prefilling or seen.get("prefilling", 0) > 0
+                ):
                     break
             time.sleep(0.01)
         else:
@@ -173,47 +202,58 @@ def spawn_and_kill(journal_dir: str, progress: str,
             child.kill()
             child.wait(timeout=30)
         child.stderr.close()
-    return {"ticks_at_kill": seen.get("ticks"), "accepted": seen.get("accepted")}
+    return {"ticks_at_kill": seen.get("ticks"), "accepted": seen.get("accepted"),
+            "prefilling_at_kill": seen.get("prefilling", 0)}
 
 
 def run_crash_restart(workdir: str, kill_after_ticks: int = 2,
-                      shared=None) -> dict:
+                      shared=None, chunked: bool = False) -> dict:
     """The full proof, parent side: reference run → child killed mid-tick →
     recovery → identity + compile-count checks. Returns a result dict (the
     chaos scenario embeds it). ``shared`` (a ``(model, params, expected)``
     triple from a previous run) skips rebuilding the deterministic reference
-    when a caller repeats the scenario."""
+    when a caller repeats the scenario. ``chunked`` is the
+    ``chunked_prefill_recovery`` variant: the child runs the paged +
+    chunked-prefill engine on the window-length workload and the kill is
+    aimed at a tick where a split admission is still mid-chunk — recovery
+    (same engine geometry) must be token-identical to the PLAIN dense
+    reference from the journaled accept alone."""
+    workload = CHUNKED_WORKLOAD if chunked else WORKLOAD
+    engine_kw = dict(CHUNKED_ENGINE_KW) if chunked else {}
     model, params, expected = shared if shared is not None else (None,) * 3
     if model is None:
         model, params = build_model()
     if expected is None:
-        expected = reference_outputs(model, params)
+        expected = reference_outputs(model, params, workload)
     journal_dir = os.path.join(workdir, "journal")
     progress = os.path.join(workdir, "progress.json")
     kill_info = spawn_and_kill(journal_dir, progress,
-                               kill_after_ticks=kill_after_ticks)
+                               kill_after_ticks=1 if chunked else kill_after_ticks,
+                               chunked=chunked, require_prefilling=chunked)
 
     from perceiver_io_tpu.serving import ServingEngine
 
     engine, info = ServingEngine.recover(model, params, journal_dir,
-                                         num_slots=NUM_SLOTS)
+                                         num_slots=NUM_SLOTS, **engine_kw)
     engine.run_until_drained(max_steps=300)
     handles = info["handles"]
     outputs = [h.result().tolist() for h in handles]
     result = {
         "sessions_recovered": info["sessions"],
-        "expected_sessions": len(WORKLOAD),
+        "expected_sessions": len(workload),
         "replayed_tokens": info["replayed_tokens"],
         "ticks_at_kill": kill_info["ticks_at_kill"],
+        "prefilling_at_kill": kill_info["prefilling_at_kill"],
         "all_finished": all(h.ok for h in handles),
         "outputs_identical": outputs == expected,
         "decode_compilations": engine.decode_compilations,
         "prefill_compilations": engine.prefill_compilations,
         "ok": (
-            info["sessions"] == len(WORKLOAD)
+            info["sessions"] == len(workload)
             and all(h.ok for h in handles)
             and outputs == expected
             and engine.decode_compilations == 1
+            and (not chunked or kill_info["prefilling_at_kill"] > 0)
         ),
         "_shared": (model, params, expected),
     }
@@ -232,18 +272,22 @@ def main(argv=None):
     ap.add_argument("--workdir", default=None,
                     help="proof mode: scratch directory (default: a tempdir)")
     ap.add_argument("--kill-after-ticks", type=int, default=2)
+    ap.add_argument("--chunked", action="store_true",
+                    help="chunked_prefill_recovery variant: paged + chunked "
+                         "engine, kill aimed mid-chunked-prefill")
     args = ap.parse_args(argv)
 
     if args.mode == "serve":
         if not (args.journal_dir and args.progress):
             ap.error("serve mode needs --journal-dir and --progress")
-        serve(args.journal_dir, args.progress)
+        serve(args.journal_dir, args.progress, chunked=args.chunked)
         return None
 
     import tempfile
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="journal-crash-")
-    result = run_crash_restart(workdir, kill_after_ticks=args.kill_after_ticks)
+    result = run_crash_restart(workdir, kill_after_ticks=args.kill_after_ticks,
+                               chunked=args.chunked)
     result.pop("_shared", None)  # live jax objects, not part of the artifact
     print(json.dumps(result, indent=1))
     if not result["ok"]:

@@ -248,6 +248,23 @@ def report_serving_metrics(path: str) -> Dict:
         # serving-metrics/v7 journal gauges (None: journal-less engine or
         # pre-v7 stream) + the recovery events ServingEngine.recover emits
         out["journal"] = snap.get("journal")
+        # serving-metrics/v8 prefix-cache / chunked-prefill gauges (None:
+        # feature off, router snapshot, or pre-v8 stream)
+        out["prefix_cache"] = snap.get("prefix_cache")
+        out["chunked_prefill"] = snap.get("chunked_prefill")
+        prefix_hits = [e for e in loaded["events"] if e.get("event") == "prefix_hit"]
+        if prefix_hits:
+            out["prefix_hit_events"] = {
+                "count": len(prefix_hits),
+                "shared_pages": sum(e.get("shared_pages", 0) for e in prefix_hits),
+                "shared_tokens": sum(e.get("shared_tokens", 0) for e in prefix_hits),
+            }
+        prefix_evicts = [e for e in loaded["events"] if e.get("event") == "prefix_evict"]
+        if prefix_evicts:
+            out["prefix_evict_events"] = {
+                "count": len(prefix_evicts),
+                "pages_freed": sum(e.get("pages_freed", 0) for e in prefix_evicts),
+            }
     recoveries = [e for e in loaded["events"] if e.get("event") == "recovery"]
     if recoveries:
         out["recoveries"] = {
@@ -348,6 +365,32 @@ def main(argv=None) -> Dict:
                   f"{jstats.get('compactions')} compactions, "
                   f"generation {jstats.get('generation')}, "
                   f"{jstats.get('live_sessions')} live sessions")
+        # v8 prefix-cache / chunked-prefill rendering (suppressed where the
+        # reader normalized to None: feature off, router, pre-v8 stream)
+        pc = section.get("prefix_cache")
+        if pc:
+            rate = pc.get("hit_rate")
+            print("prefix cache: "
+                  f"{pc.get('hits')} hits / {pc.get('misses')} misses "
+                  f"(hit rate {'n/a' if rate is None else format(rate, '.1%')}), "
+                  f"{pc.get('cached_pages')} cached pages, "
+                  f"{pc.get('shared_pages_in_use')} shared pages in use, "
+                  f"{pc.get('evictions')} evictions "
+                  f"({pc.get('evicted_pages')} pages evicted)")
+        ph = section.get("prefix_hit_events")
+        if ph:
+            print(f"  prefix hits: {ph['count']} admissions reused "
+                  f"{ph['shared_pages']} pages / {ph['shared_tokens']} tokens")
+        pe = section.get("prefix_evict_events")
+        if pe:
+            print(f"  prefix evictions: {pe['count']} episodes freed "
+                  f"{pe['pages_freed']} pages under pool pressure")
+        cp = section.get("chunked_prefill")
+        if cp:
+            print("chunked prefill: "
+                  f"{cp.get('chunks_dispatched')} chunks dispatched over "
+                  f"{cp.get('chunked_admissions')} chunked admissions "
+                  f"(chunk_tokens={cp.get('chunk_tokens')})")
         rec = section.get("recoveries")
         if rec:
             print(f"recoveries: {rec['count']} "

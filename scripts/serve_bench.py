@@ -654,6 +654,290 @@ def run_journal_overhead(model, config, params, num_slots: int, seed: int,
     return out
 
 
+def run_prefix_cache(model, config, params, num_slots: int, seed: int,
+                     repeats: int = 7, max_new: int = 8) -> dict:
+    """``--prefix-cache`` acceptance arm (docs/serving.md "Prefix cache"):
+    an 80%-SHARED-PREFIX multi-tenant workload — one shared system prompt +
+    few-shot preamble (~60% of the window) with short distinct tails, plus
+    20% fully distinct prompts — through a cache-on vs a cache-off engine at
+    EQUAL pool budget (a pool deliberately sized to ~3 dense reservations,
+    so admission is page-gated the way multi-tenant serving is HBM-gated).
+    Cache-on, a request extending the cached preamble retains those pages
+    and prefills only its tail: less prefill compute AND a smaller private
+    reservation, so more sessions fit the same pool and the burst admits in
+    fewer decode-gated waves. Reported per arm, interleaved
+    median-of-``repeats`` on live engines (the cache stays warm across
+    passes — the multi-tenant steady state; the warmup pass's cold stats
+    ride along): admission prompt tokens/s (wall to last admission), TTFT
+    p50/p95 (submit -> slot), peak concurrent sessions at the fixed budget,
+    and the cache hit rate. Greedy outputs asserted identical across arms
+    (f64 identity is pinned in tests/test_prefix_cache.py; this f32 run
+    records the observation)."""
+    from perceiver_io_tpu.serving import ServingEngine, pages_for_request
+    from perceiver_io_tpu.serving.engine import default_prefill_buckets
+
+    window = config.max_seq_len
+    page_size = max(window // 16, 2)
+    buckets = default_prefill_buckets(window, config.max_latents)
+    dense_need = pages_for_request(window, max_new, window, page_size)
+    num_pages = 3 * dense_need + 1  # ~3 dense reservations + trash page
+    # slots must NOT be the binding constraint in a page-gated arm (the
+    # multi-tenant scenario is HBM-gated): both arms get the same generous
+    # slot count and the fixed pool budget decides concurrency — cache-off
+    # fits ~3 dense reservations, cache-on fits what page sharing frees
+    num_slots = 2 * num_slots
+    rng = np.random.RandomState(seed)
+    # the shared system prompt + few-shot preamble dominates the prompt
+    # (the multi-tenant shape: a ~1.5k-token preamble, a short user tail)
+    preamble = rng.randint(1, config.vocab_size,
+                           size=int(window * 0.75)).tolist()
+    tail_hi = max(window // 8, 2)
+    k = 2 * num_slots  # same burst size as before the slot doubling above
+    prompts = []
+    for i in range(k):
+        tail = rng.randint(1, config.vocab_size,
+                           size=int(rng.randint(2, tail_hi))).tolist()
+        if i % 5 == 4:  # 20%: distinct prompt, same length population
+            prompts.append(rng.randint(
+                1, config.vocab_size, size=len(preamble) + len(tail)).tolist())
+        else:  # 80%: shared preamble + distinct tail
+            prompts.append(preamble + tail)
+
+    # telemetry=False: ambient env must not record inside a TIMED arm
+    engines = {
+        "cache_off": ServingEngine(model, params, num_slots=num_slots,
+                                   kv_page_size=page_size,
+                                   num_kv_pages=num_pages, telemetry=False),
+        "cache_on": ServingEngine(model, params, num_slots=num_slots,
+                                  kv_page_size=page_size,
+                                  num_kv_pages=num_pages, prefix_cache=True,
+                                  telemetry=False),
+    }
+
+    def one_pass(engine):
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, max_new_tokens=max_new,
+                                 rng=jax.random.PRNGKey(i))
+                   for i, p in enumerate(prompts)]
+        peak = 0
+        while engine.step():
+            peak = max(peak, engine.scheduler.active_slots)
+        wall = time.perf_counter() - t0
+        assert all(h.ok for h in handles)  # a degraded pass must not be timed
+        admit_wall = max(h.admitted_at for h in handles) - t0
+        ttfts = sorted(h.admitted_at - h.submitted_at for h in handles)
+        engine.finished.clear()
+        return (peak, admit_wall, wall, ttfts,
+                [h.result().tolist() for h in handles])
+
+    cold_stats = None
+    for name, engine in engines.items():  # warmup: compiles + warms the cache
+        one_pass(engine)
+        if name == "cache_on":
+            cold_stats = dict(engine._prefix_cache.stats())  # the COLD pass
+    samples = {n: [] for n in engines}
+    tokens_by_arm = {}
+    for _ in range(repeats):
+        for name, engine in engines.items():  # interleaved A/B
+            peak, admit, wall, ttfts, toks = one_pass(engine)
+            samples[name].append((peak, admit, wall, ttfts))
+            tokens_by_arm[name] = toks
+
+    prompt_tokens = sum(len(p) for p in prompts)
+    arms = {}
+    for name, engine in engines.items():
+        peaks = [s[0] for s in samples[name]]
+        admit = _median([s[1] for s in samples[name]])
+        wall = _median([s[2] for s in samples[name]])
+        p50s = [_pct(s[3], 0.50) for s in samples[name]]
+        p95s = [_pct(s[3], 0.95) for s in samples[name]]
+        arms[name] = {
+            "slots": num_slots,
+            "num_kv_pages": num_pages,
+            "page_size": page_size,
+            "peak_concurrent_sessions": _median(peaks),
+            "admission_wall_seconds": round(admit, 4),
+            "admission_prompt_tokens_per_s": round(prompt_tokens / admit, 2)
+            if admit > 0 else 0.0,
+            "ttft_p50_s": round(_median(p50s), 4),
+            "ttft_p95_s": round(_median(p95s), 4),
+            "drain_wall_seconds": round(wall, 4),
+            "decode_compilations": engine.decode_compilations,
+        }
+        snap = engine.metrics.snapshot()
+        if name == "cache_on":
+            arms[name]["prefix_cache_warm"] = snap["prefix_cache"]
+            arms[name]["prefix_cache_cold_pass"] = cold_stats
+        engine.close()
+    on, off = arms["cache_on"], arms["cache_off"]
+    speedup = (round(on["admission_prompt_tokens_per_s"]
+                     / off["admission_prompt_tokens_per_s"], 3)
+               if off["admission_prompt_tokens_per_s"] > 0 else 0.0)
+    return {
+        "workload": {
+            "requests": k, "shared_fraction": 0.8,
+            "preamble_tokens": len(preamble), "tail_hi": tail_hi,
+            "max_new_tokens": max_new,
+            "prompt_tokens_per_pass": prompt_tokens,
+        },
+        "kv_budget_tokens": num_pages * page_size,
+        **arms,
+        "admission_speedup": speedup,
+        "admission_speedup_ok": bool(speedup >= 2.0),  # acceptance: >= 2x
+        "ttft_p95_ratio": round(off["ttft_p95_s"] / on["ttft_p95_s"], 3)
+        if on["ttft_p95_s"] > 0 else 0.0,
+        "sessions_at_fixed_hbm_ratio": round(
+            on["peak_concurrent_sessions"] / off["peak_concurrent_sessions"], 3
+        ) if off["peak_concurrent_sessions"] else 0.0,
+        # f64 identity is the pinned contract (tests/test_prefix_cache.py)
+        "greedy_tokens_identical_f32":
+            tokens_by_arm["cache_on"] == tokens_by_arm["cache_off"],
+    }
+
+
+def run_chunked_interference(model, config, params, num_slots: int, seed: int,
+                             repeats: int = 5) -> dict:
+    """``--chunked`` interference arm (docs/serving.md "Chunked prefill"):
+    running-slot INTER-TOKEN latency under sustained mixed traffic —
+    recurring bursts of window-length prompts admitted mid-stream, chunked
+    vs unchunked. Background decode sessions stream tokens; a burst of long
+    prompts arrives every ``burst_every`` ticks; unchunked, admission fills
+    every free slot THAT TICK — each burst's one-shot O(window) prefills
+    all land inside a single tick and every running slot's next token waits
+    behind the whole pile, often enough that the bystanders' p95 IS the
+    stall — chunked (``max_prefill_slots`` bounding concurrent chunk
+    streams), admission spreads the same work at most (budget x chunk)
+    tokens per tick, bounding both the worst gap and the p95 regardless of
+    burst size. Reported per arm, interleaved median-of-``repeats``: the
+    background slots' p50/p95/max tick-to-tick token gap from the first
+    burst to background completion, plus the last burst admission span (the
+    honest price: chunked trades long-prompt TTFT for everyone else's
+    p95)."""
+    from perceiver_io_tpu.serving import ServingEngine, pages_for_request
+
+    window = config.max_seq_len
+    page_size = max(window // 16, 2)
+    chunk = max(window // 8, 1)
+    n_bg = max(num_slots - 1, 1)
+    burst_size = 4
+    burst_every = 12  # ticks between bursts (sustained arrival, not one-off)
+    n_bursts = 4
+    # slots stay SMALL: the compiled decode step's batch dim is num_slots,
+    # so oversizing the pool of slots inflates every steady tick and drowns
+    # the very stall the arm measures. One spare beyond bg + one burst;
+    # chunked streams that outlast a burst interval queue (bounded below)
+    # and admit later — the honest TTFT price the arm reports.
+    slots = n_bg + burst_size + 1
+    dense_need = pages_for_request(window, 8, window, page_size)
+    num_pages = (slots + 1) * dense_need + 1
+    rng = np.random.RandomState(seed)
+    bg_prompts = [rng.randint(1, config.vocab_size,
+                              size=int(rng.randint(4, max(window // 8, 5)))).tolist()
+                  for _ in range(n_bg)]
+    bg_max_new = 48
+    long_prompts = [rng.randint(1, config.vocab_size, size=window).tolist()
+                    for _ in range(burst_size * n_bursts)]
+
+    def build(chunked: bool) -> ServingEngine:
+        # telemetry=False: ambient env must not record inside a TIMED arm
+        return ServingEngine(
+            model, params, num_slots=slots, kv_page_size=page_size,
+            num_kv_pages=num_pages,
+            # chunked streams outlasting a burst interval park later bursts
+            # in the queue: the bound must cover the whole arrival schedule
+            max_queue_depth=4 * len(long_prompts),
+            prefill_chunk_tokens=chunk if chunked else None,
+            # the per-tick prefill budget: at most 2 concurrent chunk
+            # streams, so a tick's added prefill work is <= 2 x chunk
+            # tokens no matter how many long prompts queue up
+            max_prefill_slots=2 if chunked else None, telemetry=False,
+        )
+
+    def one_pass(engine):
+        bg = [engine.submit(p, max_new_tokens=bg_max_new,
+                            rng=jax.random.PRNGKey(i))
+              for i, p in enumerate(bg_prompts)]
+        for _ in range(4):  # background admitted and decoding
+            engine.step()
+        assert all(h.status.value == "running" for h in bg)
+        t_long = time.perf_counter()
+        lhs = []
+        gaps, last, tick = [], t_long, 0
+        while any(not h.done for h in bg):
+            if tick % burst_every == 0 and len(lhs) < len(long_prompts):
+                base = len(lhs)  # captured: extend() would read it lazily
+                burst = long_prompts[base:base + burst_size]
+                lhs.extend([engine.submit(p, max_new_tokens=4,
+                                          rng=jax.random.PRNGKey(99 + base + i))
+                            for i, p in enumerate(burst)])
+            engine.step()
+            tick += 1
+            now = time.perf_counter()
+            gaps.append(now - last)
+            last = now
+        while engine.step():
+            pass
+        assert all(h.ok for h in lhs) and all(h.ok for h in bg)
+        long_admit = max(h.admitted_at for h in lhs) - t_long
+        engine.finished.clear()
+        return sorted(gaps), long_admit, [h.result().tolist() for h in bg + lhs]
+
+    engines = {"unchunked": build(False), "chunked": build(True)}
+    for engine in engines.values():  # warmup compiles every program
+        one_pass(engine)
+    samples = {n: [] for n in engines}
+    tokens_by_arm = {}
+    for _ in range(repeats):
+        for name, engine in engines.items():  # interleaved A/B
+            gaps, long_admit, toks = one_pass(engine)
+            samples[name].append((gaps, long_admit))
+            tokens_by_arm[name] = toks
+
+    arms = {}
+    for name, engine in engines.items():
+        p50 = _median([_pct(s[0], 0.50) for s in samples[name]])
+        p95 = _median([_pct(s[0], 0.95) for s in samples[name]])
+        mx = _median([s[0][-1] for s in samples[name]])
+        arms[name] = {
+            "inter_token_p50_s": round(p50, 4),
+            "inter_token_p95_s": round(p95, 4),
+            "inter_token_max_s": round(mx, 4),
+            "long_prompt_admission_s": round(
+                _median([s[1] for s in samples[name]]), 4),
+            "decode_compilations": engine.decode_compilations,
+        }
+        if name == "chunked":
+            snap = engine.metrics.snapshot()
+            arms[name]["chunked_prefill"] = snap["chunked_prefill"]
+        engine.close()
+    ch, un = arms["chunked"], arms["unchunked"]
+    return {
+        "workload": {
+            "background_sessions": len(bg_prompts),
+            "background_max_new": bg_max_new,
+            "long_prompt_tokens": window,
+            "burst_size": burst_size,
+            "burst_every_ticks": burst_every,
+            "bursts": n_bursts,
+            "chunk_tokens": chunk,
+            "max_prefill_slots_chunked": 2,
+            "page_size": page_size,
+        },
+        **arms,
+        "inter_token_p95_ratio": round(
+            un["inter_token_p95_s"] / ch["inter_token_p95_s"], 3)
+        if ch["inter_token_p95_s"] > 0 else 0.0,
+        "inter_token_max_ratio": round(
+            un["inter_token_max_s"] / ch["inter_token_max_s"], 3)
+        if ch["inter_token_max_s"] > 0 else 0.0,
+        # the bounded-stall contract: the chunked arm's WORST gap stays
+        # under the unchunked arm's full-prompt stall
+        "stall_bounded": bool(ch["inter_token_max_s"] < un["inter_token_max_s"]),
+        "greedy_tokens_identical_f32":
+            tokens_by_arm["chunked"] == tokens_by_arm["unchunked"],
+    }
+
+
 def run_baseline(model, params, requests, warmup: bool):
     """Single-request serving: generate() per request, back-to-back, on the
     canonical padded shape (prompt left-padded to the full window)."""
@@ -908,6 +1192,22 @@ def main(argv=None) -> dict:
                          "within 10%%); the block lands in the --profile-out "
                          "artifact (BENCH_serving.json)")
     ap.add_argument("--journal-repeats", type=int, default=5)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="run the radix prefix-cache arm: 80%%-shared-prefix "
+                         "multi-tenant workload, cache-on vs cache-off at "
+                         "equal pool budget, interleaved median-of "
+                         "--prefix-repeats (acceptance: >= 2x admission "
+                         "tokens/s + better TTFT p95); the block lands in "
+                         "the --profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--prefix-repeats", type=int, default=7)
+    ap.add_argument("--chunked", action="store_true",
+                    help="run the chunked-prefill interference arm: "
+                         "running-slot inter-token p50/p95/max with a "
+                         "window-length prompt admitted mid-stream, chunked "
+                         "vs unchunked, interleaved median-of "
+                         "--chunked-repeats; the block lands in the "
+                         "--profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--chunked-repeats", type=int, default=5)
     ap.add_argument("--replicas", type=int, default=0,
                     help="run the replica-scaling arm: a burst workload through "
                          "a 1-replica vs N-replica ServingRouter (interleaved, "
@@ -935,6 +1235,18 @@ def main(argv=None) -> dict:
     def journal_arm(model, config, params):
         block = run_journal_overhead(model, config, params, args.slots,
                                      args.seed, repeats=args.journal_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def prefix_cache_arm(model, config, params):
+        block = run_prefix_cache(model, config, params, args.slots,
+                                 args.seed, repeats=args.prefix_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def chunked_arm(model, config, params):
+        block = run_chunked_interference(model, config, params, args.slots,
+                                         args.seed, repeats=args.chunked_repeats)
         block["preset"] = args.preset
         return block
 
@@ -995,6 +1307,10 @@ def main(argv=None) -> dict:
             result["priority_preemption"] = priority_arm(model, config, profile_params)
         if args.journal:
             result["journal"] = journal_arm(model, config, profile_params)
+        if args.prefix_cache:
+            result["prefix_cache"] = prefix_cache_arm(model, config, profile_params)
+        if args.chunked:
+            result["chunked_prefill"] = chunked_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
@@ -1055,6 +1371,14 @@ def main(argv=None) -> dict:
         journal = journal_arm(model, config, params)
         result["journal"] = journal
         merge_section("journal", journal, result["recorded_at"])
+    if args.prefix_cache:
+        block = prefix_cache_arm(model, config, params)
+        result["prefix_cache"] = block
+        merge_section("prefix_cache", block, result["recorded_at"])
+    if args.chunked:
+        block = chunked_arm(model, config, params)
+        result["chunked_prefill"] = block
+        merge_section("chunked_prefill", block, result["recorded_at"])
 
     tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
     with open(tmp, "w") as f:

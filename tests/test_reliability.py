@@ -613,7 +613,7 @@ def test_metrics_v3_reader_normalizes_older_snapshots(tmp_path):
     from perceiver_io_tpu.serving import EngineMetrics, load_metrics_jsonl
     from perceiver_io_tpu.serving.metrics import SCHEMA
 
-    assert SCHEMA == "serving-metrics/v7"
+    assert SCHEMA == "serving-metrics/v8"
     path = tmp_path / "v3.jsonl"
     m = EngineMetrics(num_slots=2, jsonl_path=str(path))
     m.record_submit(0, prompt_len=3)
@@ -662,11 +662,13 @@ def _load_chaos():
     return mod
 
 
-# the journal group runs in its own tests below (real subprocess kills and
-# four compaction recovery cycles blow the 120s per-test alarm budget when
-# stacked on the rest of the matrix); together the tests cover every scenario
+# the journal group (and the chunked-prefill recovery scenario, which rides
+# the same subprocess kill harness) runs in its own tests below — real
+# subprocess kills and four compaction recovery cycles blow the 120s per-test
+# alarm budget when stacked on the rest of the matrix; together the tests
+# cover every scenario
 _JOURNAL_CHECKS = ("journal_crash_restart", "journal_torn_tail",
-                   "journal_compaction_crash")
+                   "journal_compaction_crash", "chunked_prefill_recovery")
 
 
 def test_chaos_check_matrix_green(tmp_path):
@@ -703,3 +705,16 @@ def test_chaos_journal_crash_restart_real_sigkill():
     mod = _load_chaos()
     result = mod.main(["--checks", "journal_crash_restart"])
     assert result["all_ok"], result["checks"]["journal_crash_restart"]
+
+
+def test_chaos_chunked_prefill_recovery_real_sigkill():
+    """Chunked-prefill chaos (ISSUE 11): a child running the paged +
+    chunked-prefill engine is SIGKILLed while a window-length prompt is
+    still mid chunked-prefill; a fresh process recovers the half-prefilled
+    session from its journaled accept alone, f64 token-identical to an
+    uninterrupted dense run, decode still one compiled program."""
+    mod = _load_chaos()
+    result = mod.main(["--checks", "chunked_prefill_recovery"])
+    check = result["checks"]["chunked_prefill_recovery"]
+    assert result["all_ok"], check
+    assert check["prefilling_at_kill"] > 0  # the kill really landed mid-chunk
