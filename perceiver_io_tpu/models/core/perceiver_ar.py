@@ -188,7 +188,17 @@ class PagedPerceiverARCache(flax.struct.PyTreeNode):
         represented by ``live``/``shift`` alone instead of a zero-filled
         buffer. The rolled-out pad rows land past position n as inert
         garbage: never visible (``live`` bounds the window) and overwritten
-        by decode appends before they ever could be."""
+        by decode appends before they ever could be.
+
+        QUANTIZED pools (docs/serving.md "Quantized KV pages & weight
+        serving") zero those rolled-out garbage rows first — they would
+        otherwise inflate their page's amax scale and cost the real rows
+        precision — then write the prompt pages WHOLE through
+        ``PagedKVCache.write_pages`` (fresh per-page-per-head scales, bytes a
+        pure function of the page's tokens: chunk/install byte-interchange
+        survives quantization) after resetting the whole reservation's scale
+        sidecars (a later decode append into an untouched reservation page
+        must start from scale 0, zeroing any stale tenant bytes)."""
         ps = self.ca.page_size
         window = self.ca.window
         bucket = src.ca.capacity
@@ -198,14 +208,19 @@ class PagedPerceiverARCache(flax.struct.PyTreeNode):
         n = bucket - shift  # live prompt length
         kc = jnp.roll(src.ca.k[0], -shift, axis=0)
         vc = jnp.roll(src.ca.v[0], -shift, axis=0)
-        kc = jnp.pad(kc, ((0, pad_rows), (0, 0))).astype(self.ca.kp.dtype)
-        vc = jnp.pad(vc, ((0, pad_rows), (0, 0))).astype(self.ca.vp.dtype)
+        kc = jnp.pad(kc, ((0, pad_rows), (0, 0)))
+        vc = jnp.pad(vc, ((0, pad_rows), (0, 0)))
         ids = table_row[:nb]
-        ca = self.ca.replace(
-            kp=self.ca.kp.at[ids].set(kc.reshape(nb, ps, -1)),
-            vp=self.ca.vp.at[ids].set(vc.reshape(nb, ps, -1)),
-            page_table=self.ca.page_table.at[slot].set(table_row),
-            start=self.ca.start.at[slot].set(jnp.mod(n, window)),
+        ca = self.ca
+        if ca.quantized:
+            prompt_row = (jnp.arange(nb * ps) < n)[:, None]
+            kc = jnp.where(prompt_row, kc, 0)
+            vc = jnp.where(prompt_row, vc, 0)
+            ca = ca.reset_page_scales(table_row)
+        ca = ca.write_pages(ids, kc.reshape(nb, ps, -1), vc.reshape(nb, ps, -1))
+        ca = ca.replace(
+            page_table=ca.page_table.at[slot].set(table_row),
+            start=ca.start.at[slot].set(jnp.mod(n, window)),
         )
         return self.replace(
             ca=ca,
@@ -282,12 +297,19 @@ def _make_paged_ar_cache(
     num_pages: int,
     page_size: int,
     dtype=jnp.float32,
+    num_heads: int = 1,
+    kv_quant: Optional[str] = None,
 ) -> PagedPerceiverARCache:
     """Paged decode-pool state: a shared (num_pages, page_size, C) KV page
     pool (page 0 reserved as the trash page) + per-slot page tables over
     ceil(max_seq_len / page_size) logical pages, dense self-attention caches
     unchanged. ``page_size`` need not divide the window — the last logical
-    page's tail is simply never visible."""
+    page's tail is simply never visible. ``kv_quant="int8"`` stores the page
+    pool as int8 with per-page-per-head float32 scale sidecars (the KV bytes
+    per token drop ~4x vs f32; ops/paged_decode_kernel.py module docstring) —
+    the self-attention caches and everything dense stay in ``dtype``."""
+    from perceiver_io_tpu.ops.paged_decode_kernel import KV_QUANT_MODES
+
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
     if page_size > max_seq_len:
@@ -295,13 +317,26 @@ def _make_paged_ar_cache(
     pages_per_slot = -(-max_seq_len // page_size)
     if num_pages < 2:
         raise ValueError(f"num_pages must be >= 2 (page 0 is the reserved trash page), got {num_pages}")
+    if kv_quant is not None and kv_quant not in KV_QUANT_MODES:
+        raise ValueError(f"kv_quant must be one of {KV_QUANT_MODES} or None, got {kv_quant!r}")
+    if kv_quant is not None and num_channels % max(num_heads, 1) != 0:
+        raise ValueError("num_channels must divide evenly over num_heads for per-head scales")
+    pool_dtype = jnp.int8 if kv_quant else dtype
+    quant_fields = {}
+    if kv_quant:
+        quant_fields = dict(
+            k_scale=jnp.zeros((num_pages, num_heads), jnp.float32),
+            v_scale=jnp.zeros((num_pages, num_heads), jnp.float32),
+            num_heads=num_heads,
+        )
     return PagedPerceiverARCache(
         ca=PagedKVCache(
-            kp=jnp.zeros((num_pages, page_size, num_channels), dtype),
-            vp=jnp.zeros((num_pages, page_size, num_channels), dtype),
+            kp=jnp.zeros((num_pages, page_size, num_channels), pool_dtype),
+            vp=jnp.zeros((num_pages, page_size, num_channels), pool_dtype),
             page_table=jnp.zeros((batch_size, pages_per_slot), jnp.int32),
             start=jnp.zeros((batch_size,), jnp.int32),
             window=max_seq_len,
+            **quant_fields,
         ),
         sa=KVCache.create_stacked(num_layers, batch_size, max_latents, num_channels, num_channels, dtype),
         shift=jnp.zeros((batch_size, 1), jnp.int32),
@@ -685,8 +720,9 @@ class PerceiverAR(nn.Module):
         q_pos = jnp.maximum(n - latents + jnp.arange(latents)[None, :], 0)
         x_emb, frq_q = self.input_adapter(x, abs_pos=q_pos)
 
-        k_rows = ca.kp[table_row].reshape(1, -1, ca.kp.shape[-1])
-        v_rows = ca.vp[table_row].reshape(1, -1, ca.vp.shape[-1])
+        # gather_slot dequantizes on quantized pools: the finish's latents see
+        # exactly the bytes decode will gather — uniform quantization error
+        k_rows, v_rows = ca.gather_slot(table_row)
         n_phys = k_rows.shape[1]
         start = jnp.mod(n, window)
         logical = jnp.mod(jnp.arange(n_phys)[None, :] - start, window)
@@ -703,9 +739,11 @@ class PerceiverAR(nn.Module):
             x_emb, k_rows, v_rows, visible, rope_q=frq_q, rope_k=rope_k
         )
         num_channels = self.input_adapter.num_input_channels
+        # k_rows.dtype, not ca.kp.dtype: a quantized pool is int8, but the SA
+        # cache stays in the dequantized compute dtype
         sa_fresh = KVCache.create_stacked(
             self.num_self_attention_layers, b, latents, num_channels,
-            num_channels, ca.kp.dtype,
+            num_channels, k_rows.dtype,
         )
         sa_slot_pos = jnp.maximum(n - latents + jnp.arange(latents)[None, :], 0)
         rope_k_sa = frequency_position_encoding(sa_slot_pos, rot)
@@ -846,16 +884,20 @@ class CausalSequenceModel(nn.Module):
         return self._head(hidden), cache
 
     def init_paged_cache(
-        self, batch_size: int, num_pages: int, page_size: int, dtype=jnp.float32
+        self, batch_size: int, num_pages: int, page_size: int, dtype=jnp.float32,
+        kv_quant: Optional[str] = None,
     ) -> PagedPerceiverARCache:
         """Paged decode-pool state for the serving engine (serving/paging.py):
         a shared KV page pool + per-slot page tables in place of the dense
         per-slot full-window cross-attention cache. Built from config only,
-        so it works on an unbound module."""
+        so it works on an unbound module. ``kv_quant="int8"`` makes the pool
+        int8 with per-page-per-head scale sidecars (docs/serving.md
+        "Quantized KV pages & weight serving")."""
         cfg = self.config
         return _make_paged_ar_cache(
             batch_size, cfg.max_seq_len, cfg.max_latents, cfg.num_self_attention_layers,
             cfg.num_channels, num_pages, page_size, dtype,
+            num_heads=cfg.num_heads, kv_quant=kv_quant,
         )
 
     def prefill_chunk_kv(

@@ -230,7 +230,8 @@ class MultiHeadAttention(nn.Module):
 
         n_phys = kv_cache.pages_per_slot * kv_cache.page_size
         if self.use_flash is not False and pdk.paged_decode_supported(
-            kv_cache.page_size, num_qk, num_v, self.num_heads
+            kv_cache.page_size, num_qk, num_v, self.num_heads,
+            quantized=kv_cache.quantized,
         ):
             ang = rope_k if rope_k is not None else jnp.zeros((b, n_phys, 2), jnp.float32)
             if ang.shape[0] != b:
@@ -241,6 +242,9 @@ class MultiHeadAttention(nn.Module):
                 # the ragged kill-switch disables the dead-page skip (every
                 # page fetched + masked) but never the visibility bound
                 skip_dead_pages=ragged_decode_enabled(),
+                # int8 pools: scales ride the scalar-prefetch path and the
+                # dequant fuses into the page stream (None on fp pools)
+                k_scale=kv_cache.k_scale, v_scale=kv_cache.v_scale,
             )
         else:
             k_full, v_full = kv_cache.gather_dense()

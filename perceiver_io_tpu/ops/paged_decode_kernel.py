@@ -33,17 +33,72 @@ The XLA fallback (the masked-softmax path in ops/attention.py's paged branch)
 gathers the pages dense and applies the same visibility bound — bitwise the
 same masking contract, used on CPU and wherever ``paged_decode_supported``
 says no.
+
+Quantized pages (int8; docs/serving.md "Quantized KV pages & weight
+serving"): with ``kv_quant="int8"`` each (page, head) stores int8 KV plus a
+per-page-per-head float32 SCALE sidecar (``k_scale``/``v_scale``, shape
+(num_pages, num_heads); dequant ``x̂ = q * s``, ``s = amax / 127`` over the
+page's rows of that head). Every write path quantizes: whole-page writes
+(``write_pages`` — the one-shot install; ``write_rows`` — page-aligned chunk
+blocks) stamp a fresh scale per page so a page's bytes are a pure function
+of its tokens (the prefix-cache byte-interchange contract survives
+quantization), while the per-token ring append (``append_token``) RATCHETS:
+the page scale grows monotonically to cover the incoming row and the page's
+existing int8 entries are requantized by the exact old/new ratio — one extra
+page read-modify-write per token, marginal next to the full-window page
+gather the decode attention itself performs. A freshly allocated page's
+scale is reset to 0 (``reset_page_scales`` / the install's full-row scale
+stamp), which makes the first ratcheted write ZERO any stale bytes a
+previous tenant left — pool history can never leak into a new session's
+bytes. The fused kernel gains a dequant-fused variant (scales ride the
+scalar-prefetch path next to the page table; dead-page skip and ring-offset
+semantics unchanged), pinned BITWISE in interpret mode against feeding the
+XLA-dequantized f32 pool through the same kernel; ``gather_dense``/
+``gather_slot`` dequantize for the XLA fallback and the prefill-finish so
+CPU and sharded pools serve the same layout.
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Optional, Tuple
 
 import flax.struct
 import jax
 import jax.numpy as jnp
 
 from perceiver_io_tpu.ops.decode_kernel import _head_expander, _rotate_half_blockdiag
+
+# supported quantized-page modes (serving/engine.py `kv_quant` knob)
+KV_QUANT_MODES = ("int8",)
+# int8 quantization: q = clip(round(x / s), -127, 127), s = amax / 127 —
+# symmetric, -128 unused so dequant never exceeds the observed amax
+_QMAX = 127.0
+
+
+def _amax_per_head(rows: jax.Array, num_heads: int) -> jax.Array:
+    """Per-head abs-max of ``rows`` (..., n, H*d) over the row and channel
+    axes of each head -> (..., H). The quantization scope is (page, head):
+    one scale covers every row and channel the head owns in that page."""
+    d = rows.shape[-1] // num_heads
+    r = rows.reshape(*rows.shape[:-2], rows.shape[-2], num_heads, d)
+    return jnp.max(jnp.abs(r), axis=(-3, -1))
+
+
+def _expand_scale(scale: jax.Array, d: int) -> jax.Array:
+    """(..., H) per-head scales -> (..., H*d) per-channel (head-major channel
+    order, matching the (H, d) reshape everywhere in this module)."""
+    return jnp.repeat(scale, d, axis=-1)
+
+
+def _quantize_blocks(rows_f32: jax.Array, scale: jax.Array, d: int) -> jax.Array:
+    """Quantize ``rows_f32`` (..., n, H*d) under per-head ``scale`` (..., H):
+    q = clip(round(x / s), ±127) int8; a zero scale (all-zero page) yields
+    zero bytes instead of a division blowup."""
+    sc = _expand_scale(scale, d)[..., None, :]
+    safe = jnp.where(sc > 0, sc, 1.0)
+    q = jnp.where(sc > 0, jnp.round(rows_f32 / safe), 0.0)
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
 
 
 class PagedKVCache(flax.struct.PyTreeNode):
@@ -71,6 +126,13 @@ class PagedKVCache(flax.struct.PyTreeNode):
     page_table: jax.Array
     start: jax.Array
     window: int = flax.struct.field(pytree_node=False)
+    # quantized mode (int8 pages): per-page-per-head float32 scale sidecars,
+    # None on full-precision pools — the fp paths trace exactly as before
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+    # head count of the serving attention layer — the quantization grouping
+    # (scale scope = one head's channels within one page); unused (1) on fp
+    num_heads: int = flax.struct.field(pytree_node=False, default=1)
 
     @property
     def page_size(self) -> int:
@@ -84,21 +146,61 @@ class PagedKVCache(flax.struct.PyTreeNode):
     def pages_per_slot(self) -> int:
         return self.page_table.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def head_dim(self) -> int:
+        return self.kp.shape[-1] // self.num_heads
+
     def append_token(self, k_new: jax.Array, v_new: jax.Array) -> "PagedKVCache":
         """Write one token's (B, 1, C) keys/values at each row's ring position
         ``start`` — through the page table — and advance ``start``. O(1) per
         token: the dense layout's full-buffer roll becomes a B-row scatter.
         Rows whose table maps the write page to the trash page (free slots)
         harmlessly deposit garbage there; distinct live slots never share a
-        writable page (the page pool's allocation invariant)."""
+        writable page (the page pool's allocation invariant).
+
+        Quantized pools RATCHET the write page's per-head scale: the scale
+        grows (never shrinks) to cover the incoming row and the page's
+        existing int8 entries are requantized by the exact ``old/new`` ratio
+        (|q'| <= |q| <= 127, no clipping introduced). A fresh page's scale is
+        0, so its first write zeroes whatever stale bytes the previous tenant
+        left — bytes are a pure function of this slot's write history, never
+        of pool history (the determinism contract chaos pins). The ratchet's
+        page read-modify-write is O(page) per row — marginal next to the
+        full-window page gather the decode attention performs each token."""
         b = k_new.shape[0]
         ps = self.page_size
         bidx = jnp.arange(b)
         page_ids = self.page_table[bidx, self.start // ps]
         offs = self.start % ps
+        if not self.quantized:
+            return self.replace(
+                kp=self.kp.at[page_ids, offs].set(k_new[:, 0].astype(self.kp.dtype)),
+                vp=self.vp.at[page_ids, offs].set(v_new[:, 0].astype(self.vp.dtype)),
+                start=jnp.mod(self.start + 1, self.window),
+            )
+        h, d = self.num_heads, self.head_dim
+
+        def upd(pool, scales, row):
+            row = row.astype(jnp.float32)  # (B, C)
+            rmax = jnp.max(jnp.abs(row.reshape(b, h, d)), axis=-1)  # (B, H)
+            old = scales[page_ids]  # (B, H)
+            new = jnp.maximum(old, rmax / _QMAX)
+            # old == 0 (fresh page) -> ratio 0: stale tenant bytes are zeroed
+            ratio = jnp.where(new > 0, old / jnp.where(new > 0, new, 1.0), 0.0)
+            pages = pool[page_ids].astype(jnp.float32)  # (B, ps, C)
+            pages = jnp.round(pages * _expand_scale(ratio, d)[:, None, :])
+            qrow = _quantize_blocks(row[:, None, :], new, d)[:, 0]  # (B, C)
+            pages = pages.astype(jnp.int8).at[bidx, offs].set(qrow)
+            return pool.at[page_ids].set(pages), scales.at[page_ids].set(new)
+
+        kp, ks = upd(self.kp, self.k_scale, k_new[:, 0])
+        vp, vs = upd(self.vp, self.v_scale, v_new[:, 0])
         return self.replace(
-            kp=self.kp.at[page_ids, offs].set(k_new[:, 0].astype(self.kp.dtype)),
-            vp=self.vp.at[page_ids, offs].set(v_new[:, 0].astype(self.vp.dtype)),
+            kp=kp, vp=vp, k_scale=ks, v_scale=vs,
             start=jnp.mod(self.start + 1, self.window),
         )
 
@@ -120,31 +222,135 @@ class PagedKVCache(flax.struct.PyTreeNode):
         scatter indices carry identical payloads and the pool stays
         deterministic (the quarantine discipline). Real rows always map to
         allocated table entries: the engine only writes positions inside the
-        slot's reservation, and never below a shared prefix's boundary."""
+        slot's reservation, and never below a shared prefix's boundary.
+
+        Quantized pools take a PAGE-BLOCK path instead of the row scatter:
+        the engine guarantees every quantized chunk write starts page-aligned
+        (``prefill_chunk_tokens`` must be a multiple of the page size — ctor
+        validated), so rows group into whole local pages. Each page covered
+        by real rows is written WHOLE (rows past ``count`` as zeros — the
+        partial tail page's unwritten rows become deterministic zeros instead
+        of stale garbage) with a fresh per-head scale over exactly its
+        written rows; a page's bytes are therefore a pure function of its
+        tokens, byte-interchangeable with an install-built page — the
+        property the cross-request prefix cache keys on. Blocks with no real
+        row write zero payloads + zero scales to the trash page, exactly the
+        fp path's padding discipline."""
         cmax = k_rows.shape[0]
         ps = self.page_size
         p = self.page_table.shape[1]
-        j = jnp.arange(cmax)
-        phys = offset + j
+        if not self.quantized:
+            j = jnp.arange(cmax)
+            phys = offset + j
+            real = j < count
+            pidx = jnp.clip(phys // ps, 0, p - 1)
+            page_ids = jnp.where(real, table_row[pidx], 0)
+            offs = jnp.where(real, phys % ps, 0)
+            kz = jnp.where(real[:, None], k_rows, 0).astype(self.kp.dtype)
+            vz = jnp.where(real[:, None], v_rows, 0).astype(self.vp.dtype)
+            return self.replace(
+                kp=self.kp.at[page_ids, offs].set(kz),
+                vp=self.vp.at[page_ids, offs].set(vz),
+            )
+        h, d = self.num_heads, self.head_dim
+        lp = -(-cmax // ps)  # local pages the static row capacity can span
+        pad = lp * ps - cmax
+        j = jnp.arange(lp * ps)
         real = j < count
-        pidx = jnp.clip(phys // ps, 0, p - 1)
-        page_ids = jnp.where(real, table_row[pidx], 0)
-        offs = jnp.where(real, phys % ps, 0)
-        kz = jnp.where(real[:, None], k_rows, 0).astype(self.kp.dtype)
-        vz = jnp.where(real[:, None], v_rows, 0).astype(self.vp.dtype)
+        li = jnp.arange(lp)
+        block_real = (li * ps) < count  # block l holds >= 1 real row
+        pidx = jnp.clip(offset // ps + li, 0, p - 1)
+        page_ids = jnp.where(block_real, table_row[pidx], 0)
+
+        def q(rows, pool, scales):
+            rz = jnp.pad(rows.astype(jnp.float32), ((0, pad), (0, 0)))
+            rz = jnp.where(real[:, None], rz, 0.0)
+            blocks = rz.reshape(lp, ps, h * d)
+            scale = _amax_per_head(blocks, h) / _QMAX  # (lp, H)
+            qb = _quantize_blocks(blocks, scale, d)
+            return (
+                pool.at[page_ids].set(qb),
+                scales.at[page_ids].set(jnp.where(block_real[:, None], scale, 0.0)),
+            )
+
+        kp, ks = q(k_rows, self.kp, self.k_scale)
+        vp, vs = q(v_rows, self.vp, self.v_scale)
+        return self.replace(kp=kp, vp=vp, k_scale=ks, v_scale=vs)
+
+    def write_pages(
+        self, ids: jax.Array, k_blocks: jax.Array, v_blocks: jax.Array
+    ) -> "PagedKVCache":
+        """Overwrite whole pages ``ids`` (nb,) with ``k_blocks``/``v_blocks``
+        (nb, ps, C) — the one-shot install's page scatter
+        (PagedPerceiverARCache.install_slot). Quantized pools stamp a fresh
+        per-head scale per page (amax over exactly the page's rows), so an
+        install-built page is byte-interchangeable with a chunk-built one."""
+        if not self.quantized:
+            return self.replace(
+                kp=self.kp.at[ids].set(k_blocks.astype(self.kp.dtype)),
+                vp=self.vp.at[ids].set(v_blocks.astype(self.vp.dtype)),
+            )
+        h, d = self.num_heads, self.head_dim
+
+        def q(blocks, pool, scales):
+            bf = blocks.astype(jnp.float32)
+            scale = _amax_per_head(bf, h) / _QMAX  # (nb, H)
+            return (
+                pool.at[ids].set(_quantize_blocks(bf, scale, d)),
+                scales.at[ids].set(scale),
+            )
+
+        kp, ks = q(k_blocks, self.kp, self.k_scale)
+        vp, vs = q(v_blocks, self.vp, self.v_scale)
+        return self.replace(kp=kp, vp=vp, k_scale=ks, v_scale=vs)
+
+    def reset_page_scales(self, ids: jax.Array) -> "PagedKVCache":
+        """Zero the scale sidecars of pages ``ids`` — the engine runs this
+        over a split admission's PRIVATE reservation before any chunk writes
+        (a page's first ratcheted append then zeroes stale tenant bytes:
+        scale 0 makes the requantize ratio 0). Shared prefix pages are never
+        reset — their scales belong to the cached bytes. No-op on fp pools;
+        duplicate ids (trash-page padding) re-zero page 0 harmlessly."""
+        if not self.quantized:
+            return self
         return self.replace(
-            kp=self.kp.at[page_ids, offs].set(kz),
-            vp=self.vp.at[page_ids, offs].set(vz),
+            k_scale=self.k_scale.at[ids].set(0.0),
+            v_scale=self.v_scale.at[ids].set(0.0),
         )
 
     def gather_dense(self):
         """(B, P*page_size, C) dense view through the page table — the XLA
         fallback's input. Materializes the full logical window per row; the
-        kernel path exists so the serving hot loop never does."""
+        kernel path exists so the serving hot loop never does. Quantized
+        pools dequantize through the gathered scales (``q.astype(f32) * s``
+        — the exact multiply the fused kernel performs, so fallback and
+        kernel read identical values)."""
         b = self.page_table.shape[0]
-        k = self.kp[self.page_table].reshape(b, -1, self.kp.shape[-1])
-        v = self.vp[self.page_table].reshape(b, -1, self.vp.shape[-1])
-        return k, v
+        k = self.kp[self.page_table]  # (B, P, ps, C)
+        v = self.vp[self.page_table]
+        if self.quantized:
+            d = self.head_dim
+            k = k.astype(jnp.float32) * _expand_scale(
+                self.k_scale[self.page_table], d)[:, :, None, :]
+            v = v.astype(jnp.float32) * _expand_scale(
+                self.v_scale[self.page_table], d)[:, :, None, :]
+        return (k.reshape(b, -1, self.kp.shape[-1]),
+                v.reshape(b, -1, self.vp.shape[-1]))
+
+    def gather_slot(self, table_row: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """ONE slot's page rows in physical ring order, (1, P*ps, C) —
+        dequantized on quantized pools: the chunked-prefill FINISH reads the
+        slot's already-written pages through this (models/core/perceiver_ar.
+        prefill_latents_paged), so its latents see exactly the bytes decode
+        will gather — quantization error included, uniformly."""
+        k = self.kp[table_row]  # (P, ps, C)
+        v = self.vp[table_row]
+        if self.quantized:
+            d = self.head_dim
+            k = k.astype(jnp.float32) * _expand_scale(self.k_scale[table_row], d)[:, None, :]
+            v = v.astype(jnp.float32) * _expand_scale(self.v_scale[table_row], d)[:, None, :]
+        return (k.reshape(1, -1, self.kp.shape[-1]),
+                v.reshape(1, -1, self.vp.shape[-1]))
 
 
 def paged_visibility(start: jax.Array, live: jax.Array, window: int, n_phys: int) -> jax.Array:
@@ -160,13 +366,17 @@ def paged_visibility(start: jax.Array, live: jax.Array, window: int, n_phys: int
 
 
 def paged_decode_supported(
-    page_size: int, num_qk: int, num_v: int, num_heads: int = 1, n_q: int = 1
+    page_size: int, num_qk: int, num_v: int, num_heads: int = 1, n_q: int = 1,
+    quantized: bool = False,
 ) -> bool:
     """Single-query paged decode on TPU: symmetric qk/v widths, sublane-aligned
     pages. Multi-chip pools are not yet mapped onto this kernel (the paged
     pool is a single shared buffer; shard_map dispatch is future work) — the
-    XLA fallback serves those. Kill-switch:
-    PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL (shared with the dense kernel)."""
+    XLA fallback serves those. Quantized (int8) pools additionally need
+    32-row pages (the int8 VMEM tile is (32, 128)); the XLA fallback serves
+    smaller quantized pages with the identical dequant + masking contract.
+    Kill-switch: PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL (shared with the
+    dense kernel)."""
     import os
 
     if os.environ.get("PERCEIVER_IO_TPU_DISABLE_DECODE_KERNEL", "0").lower() not in ("0", "false", ""):
@@ -179,6 +389,7 @@ def paged_decode_supported(
         and num_heads <= 128  # per-head stats live in one (8, 128) scratch row
         and page_size % 8 == 0  # sublane-aligned page blocks
         and page_size >= 8
+        and (not quantized or page_size % 32 == 0)  # int8 tile alignment
     )
 
 
@@ -194,8 +405,7 @@ def _page_has_live(i, start, live, window: int, page_size: int):
     return (live > 0) & (((s0 >= p0) & (s0 <= p1)) | (jnp.mod(p0 - s0, window) < live))
 
 
-def _paged_kernel(start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref,
-                  rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref, *, window, skip_dead_pages):
+def _paged_kernel(*refs, window, skip_dead_pages, quantized):
     """Grid (B, P); step (bi, i) covers physical ring positions
     [i*ps, (i+1)*ps) of row bi, DMA'd through the page table.
 
@@ -218,8 +428,26 @@ def _paged_kernel(start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref
     rescales m/l/acc by exp(0) = 1 (tests/test_paging.py pins skip-on vs
     skip-off bitwise). The per-position visibility mask applies the SAME
     bound, so mid-page live boundaries are exact too.
+
+    QUANTIZED pools add two scalar-prefetch sidecars right after the page
+    table — kscale_ref / vscale_ref (N, h) f32, per-page-per-head scales —
+    and k_ref/v_ref blocks arrive int8. The dequant is FUSED: the fetched
+    block's scale row is read from SMEM (h static scalar loads at the page
+    id the index map fetched — un-aliased whenever compute runs), expanded
+    to channels through the same head expander the stats use, and multiplied
+    into the f32 upcast before rotation — bit-identical to feeding the
+    XLA-dequantized f32 pool through this same kernel (tests pin it).
     """
     import jax.experimental.pallas as pl
+
+    if quantized:
+        (start_ref, live_ref, table_ref, kscale_ref, vscale_ref, qbd_ref,
+         k_ref, v_ref, ang_ref, rot_ref, exp_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref,
+         rot_ref, exp_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+        kscale_ref = vscale_ref = None
 
     bi = pl.program_id(0)
     i = pl.program_id(1)
@@ -249,6 +477,24 @@ def _paged_kernel(start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref
         sin = jnp.concatenate(([jnp.sin(ang)] + fill) * h, -1)
 
         k = k_ref[0].astype(jnp.float32)  # (ps, h*d)
+        if quantized:
+            # whenever compute runs, the page is live and the index map did
+            # not alias, so the fetched block IS page table_ref[bi, i] —
+            # read its per-head scale row from SMEM (h static scalar loads)
+            # and expand head -> channels through the same 0/1 expander
+            # (exact selection: one nonzero term per channel)
+            page_id = table_ref[bi, i]
+            kscale = jnp.stack(
+                [kscale_ref[page_id, hh] for hh in range(h)]
+            ).reshape(1, h)
+            vscale = jnp.stack(
+                [vscale_ref[page_id, hh] for hh in range(h)]
+            ).reshape(1, h)
+            kexp = jax.lax.dot_general(kscale, exp_ref[:], contract,
+                                       preferred_element_type=jnp.float32)
+            vexp = jax.lax.dot_general(vscale, exp_ref[:], contract,
+                                       preferred_element_type=jnp.float32)
+            k = k * kexp  # fused dequant, before rotation — the fallback's order
         rot_half = jax.lax.dot_general(k, rot_ref[:], contract, preferred_element_type=jnp.float32)
         k = k * cos + rot_half * sin
 
@@ -265,7 +511,10 @@ def _paged_kernel(start_ref, live_ref, table_ref, qbd_ref, k_ref, v_ref, ang_ref
         prob = jnp.exp(jnp.where(jnp.isfinite(sc), sc - safe_m, -jnp.inf))  # (ps, h)
 
         prob_x = jax.lax.dot_general(prob, exp_ref[:], contract, preferred_element_type=jnp.float32)
-        pv = jnp.sum(prob_x * v_ref[0].astype(jnp.float32), axis=0, keepdims=True)  # (1, h*d)
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            v = v * vexp  # fused value dequant
+        pv = jnp.sum(prob_x * v, axis=0, keepdims=True)  # (1, h*d)
         scale_x = jax.lax.dot_general(scale, exp_ref[:], contract, preferred_element_type=jnp.float32)
 
         m_ref[0:1, :h] = m_new
@@ -291,6 +540,8 @@ def fused_paged_decode_attention(
     window: int,
     skip_dead_pages: bool = True,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q (B, H, 1, D) scaled+rotated single query; kp/vp (N, ps, H*D)
     unrotated page pool; page_table (B, P); start (B,) POST-append ring
@@ -299,7 +550,11 @@ def fused_paged_decode_attention(
 
     ``skip_dead_pages=False`` disables the dead-page alias/skip (every page is
     fetched and masked) — the bitwise-parity reference arm and the ragged
-    kill-switch behavior (ragged_decode_enabled, ops/decode_kernel.py)."""
+    kill-switch behavior (ragged_decode_enabled, ops/decode_kernel.py).
+
+    ``k_scale``/``v_scale`` (N, H) switch on the FUSED-DEQUANT variant for
+    int8 pools (module docstring): the scales ride the scalar-prefetch path
+    next to the page table, dead-page skip and ring semantics unchanged."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -308,6 +563,8 @@ def fused_paged_decode_attention(
     n_pages, ps, hd = kp.shape
     p = page_table.shape[1]
     r = rope_k.shape[-1]
+    quantized = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
 
     start = jnp.asarray(start, jnp.int32).reshape(-1)
     live = jnp.asarray(live, jnp.int32).reshape(-1)
@@ -328,14 +585,21 @@ def fused_paged_decode_attention(
         newest = jnp.mod(s - 1, window) // ps
         return jnp.where(_page_has_live(i, s, lv, window, ps), i, newest)
 
-    def _kv_map(bi, i, start_ref, live_ref, table_ref):
+    def _kv_map(bi, i, start_ref, live_ref, table_ref, *_):
         return (table_ref[bi, _alias(i, start_ref, live_ref, bi)], 0, 0)
 
-    def _ang_map(bi, i, start_ref, live_ref, table_ref):
+    def _ang_map(bi, i, start_ref, live_ref, table_ref, *_):
         return (bi, _alias(i, start_ref, live_ref, bi), 0)
 
+    # quantized pools prefetch the scale sidecars right after the page table
+    # (SMEM, like start/live/table — the kernel reads the fetched page's
+    # scale row with static per-head scalar loads)
+    prefetch = [start, live, jnp.asarray(page_table, jnp.int32)]
+    if quantized:
+        prefetch += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=len(prefetch),
         grid=(b, p),
         in_specs=[
             pl.BlockSpec((None, h * d, h), lambda bi, i, *_: (bi, 0, 0)),
@@ -353,14 +617,13 @@ def fused_paged_decode_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, window=window, skip_dead_pages=skip_dead_pages),
+        functools.partial(_paged_kernel, window=window,
+                          skip_dead_pages=skip_dead_pages, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
         interpret=interpret,
     )(
-        start,
-        live,
-        jnp.asarray(page_table, jnp.int32),
+        *prefetch,
         qbd,
         kp,
         vp,

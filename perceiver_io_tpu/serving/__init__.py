@@ -37,11 +37,17 @@ from perceiver_io_tpu.serving.paging import (
     PagePool,
     PrefixCache,
     chunked_prefill_enabled,
+    kv_quant_enabled,
     page_keys_for_prompt,
     paged_kv_enabled,
     pages_for_request,
     pages_for_tokens,
     prefix_cache_enabled,
+)
+from perceiver_io_tpu.serving.quant import (
+    dequantize_params,
+    quantize_params_int8,
+    serve_params,
 )
 from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
@@ -57,8 +63,12 @@ __all__ = [
     "PagePool",
     "PrefixCache",
     "chunked_prefill_enabled",
+    "dequantize_params",
+    "kv_quant_enabled",
     "page_keys_for_prompt",
     "paged_kv_enabled",
+    "quantize_params_int8",
+    "serve_params",
     "pages_for_request",
     "pages_for_tokens",
     "preemption_enabled",

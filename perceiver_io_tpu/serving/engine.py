@@ -108,7 +108,22 @@ dead-page skip — the visibility bound is load-bearing there);
 the pre-priority engine, pinned by the ``preempt_disabled_inert`` chaos
 scenario); ``PERCEIVER_IO_TPU_DISABLE_JOURNAL=1`` makes a configured
 request journal inert — no files touched, behavior bit-identical to
-``journal=None`` (serving/journal.py, tests/test_journal.py).
+``journal=None`` (serving/journal.py, tests/test_journal.py);
+``PERCEIVER_IO_TPU_DISABLE_KV_QUANT=1`` forces full-precision pages AND
+untouched served params regardless of ``kv_quant``/``weight_dtype`` —
+f64 token-identical to the pre-quantization engine (tests/test_kv_quant.py).
+
+Quantized serving (docs/serving.md "Quantized KV pages & weight serving"):
+``kv_quant="int8"`` stores the paged KV pools as int8 with per-page-per-head
+scale sidecars — dequant fused into the paged decode kernel, the identical
+XLA fallback on CPU/sharded pools, every write path quantizing
+deterministically (whole-page stamps for install/chunk writes so prefix
+pages stay byte-interchangeable; a ratcheting requantize for the per-token
+ring append) — and ``weight_dtype="bf16"|"int8"`` shrinks the served params
+alongside (serving/quant.py: bf16 cast, or per-tensor int8 dequantized on
+program entry). Quantization is lossy by design: quality is MEASURED
+(greedy agreement + CE deltas, ``serve_bench --kv-quant``), never assumed,
+and with the knobs off the engine is bit-exactly its pre-quantization self.
 
 Crash durability (serving/journal.py; docs/serving.md "Request journal"):
 with ``journal=<dir>`` every accepted request is durable before ``submit``
@@ -159,10 +174,16 @@ from perceiver_io_tpu.serving.paging import (
     PagePool,
     PrefixCache,
     chunked_prefill_enabled,
+    kv_quant_enabled,
     page_keys_for_prompt,
     paged_kv_enabled,
     pages_for_request,
     prefix_cache_enabled,
+)
+from perceiver_io_tpu.serving.quant import (
+    WEIGHT_DTYPES,
+    kv_bytes_per_token,
+    serve_params,
 )
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
@@ -393,9 +414,26 @@ class ServingEngine:
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: bool = False,
         max_prefill_slots: Optional[int] = None,
+        kv_quant: Optional[str] = None,
+        weight_dtype: Optional[str] = None,
     ):
         self.model = model
-        self.params = params
+        # Weight-serving transform (serving/quant.py; docs/serving.md
+        # "Quantized KV pages & weight serving"): bf16 casts float leaves,
+        # int8 stores matmul-grade leaves as int8 + per-tensor scale and the
+        # compiled programs dequantize on entry — resident param HBM drops
+        # alongside the KV pool's. weight_dtype=None (and the
+        # PERCEIVER_IO_TPU_DISABLE_KV_QUANT kill-switch) pass the tree
+        # through UNTOUCHED: the f64 parity pins run the identity path.
+        if weight_dtype is not None and weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(
+                f"weight_dtype must be one of {WEIGHT_DTYPES} or None, got {weight_dtype!r}"
+            )
+        self.weight_dtype = weight_dtype if kv_quant_enabled() else None
+        (self.params, self._dequant_params,
+         self._param_bytes, self._param_bytes_fp) = serve_params(
+            params, self.weight_dtype
+        )
         self.num_slots = num_slots
         # observability namespace: a router fronting N engines on ONE shared
         # recorder gives each replica its own prefix ("serving.r0", ...) so
@@ -532,6 +570,25 @@ class ServingEngine:
             raise ValueError(
                 f"kv_page_size must lie in [1..window={self._window}], got {kv_page_size}"
             )
+        # Quantized KV pages (docs/serving.md "Quantized KV pages & weight
+        # serving"): int8 pool + per-page-per-head scale sidecars. Requires
+        # paging (quantization is a PAGE layout); configuring it on a
+        # dense-by-construction engine is a caller bug, while the paged/quant
+        # kill-switches forcing fp silently disable it (a rollback lever
+        # must never crash the engine it rolls back).
+        from perceiver_io_tpu.ops.paged_decode_kernel import KV_QUANT_MODES
+
+        if kv_quant is not None and kv_quant not in KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quant must be one of {KV_QUANT_MODES} or None, got {kv_quant!r}"
+            )
+        if kv_quant is not None and kv_page_size is None:
+            raise ValueError("kv_quant requires kv_page_size (quantization is "
+                             "a page layout)")
+        self.kv_quant: Optional[str] = (
+            kv_quant if (kv_quant is not None and self.paged and kv_quant_enabled())
+            else None
+        )
         if self.paged:
             self.kv_page_size = int(kv_page_size)
             self._pages_per_slot = -(-self._window // self.kv_page_size)
@@ -556,7 +613,8 @@ class ServingEngine:
             # block reports one alloc_failure episode rather than one per tick
             self._alloc_blocked_id: Optional[int] = None
             cache = model.init_paged_cache(
-                num_slots, pages, self.kv_page_size, dtype=self.cache_dtype
+                num_slots, pages, self.kv_page_size, dtype=self.cache_dtype,
+                kv_quant=self.kv_quant,
             )
             # factory pins live at the window; pin the SA lengths full too —
             # the shared-fill-level invariant the dense pool also maintains
@@ -598,11 +656,29 @@ class ServingEngine:
                         and chunked_prefill_enabled())
         self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
                                      if self.chunked else None)
+        if (self.kv_quant is not None and self.chunked
+                and self.prefill_chunk_tokens % self.kv_page_size != 0):
+            # quantized chunk writes are whole-page block writes: every chunk
+            # must start page-aligned or a later chunk would overwrite a
+            # partially quantized page (ops/paged_decode_kernel.write_rows)
+            raise ValueError(
+                f"prefill_chunk_tokens ({self.prefill_chunk_tokens}) must be a "
+                f"multiple of kv_page_size ({self.kv_page_size}) under kv_quant"
+            )
         self.max_prefill_slots = (int(max_prefill_slots)
                                   if max_prefill_slots is not None else num_slots)
         self._prefix_cache: Optional[PrefixCache] = None
         if prefix_cache and self.paged and prefix_cache_enabled():
-            self._prefix_cache = PrefixCache(self._pool, self.kv_page_size)
+            # the cache is keyed on the pool's byte layout: its mode is fixed
+            # at construction. A cache built HERE trivially matches this
+            # engine, so this ensure_mode cannot fire today — it stands as
+            # the attach-point contract: any future externally-supplied or
+            # persisted cache MUST pass through the same check before its
+            # pages are served (an fp reader handed int8 pages would gather
+            # garbage magnitudes — the seam tests pin both directions).
+            self._prefix_cache = PrefixCache(self._pool, self.kv_page_size,
+                                             kv_quant=self.kv_quant)
+            self._prefix_cache.ensure_mode(self.kv_quant)
         # slot -> in-flight split-prefill task (chunk phase; empty on the
         # classic one-shot path, where admission completes inside _admit)
         self._prefilling: Dict[int, _PrefillTask] = {}
@@ -612,6 +688,18 @@ class ServingEngine:
             self.metrics.set_chunked_prefill(self.prefill_chunk_tokens)
         if self._prefix_cache is not None:
             self.metrics.set_prefix_cache(self._prefix_cache.stats(), 0)
+        # serving-metrics/v9 gauges: quantized-page byte economics and the
+        # weight-serving dtype/bytes — None (off) on fp engines
+        if self.kv_quant is not None:
+            fp_b, served_b = kv_bytes_per_token(
+                cfg.num_channels, self.cache_dtype, self.kv_quant,
+                self.kv_page_size, cfg.num_heads,
+            )
+            self.metrics.set_kv_quant(self.kv_quant, fp_b, served_b)
+        if self.weight_dtype is not None:
+            self.metrics.set_weight_serving(
+                self.weight_dtype, self._param_bytes, self._param_bytes_fp
+            )
         # logits carry the cache/compute dtype (f64 parity tests, bf16 TPU
         # serving); storing them narrower would silently cast at install
         self._state = SlotState.create(num_slots, self._vocab, logits_dtype=self.cache_dtype)
@@ -644,6 +732,9 @@ class ServingEngine:
                                     budget=len(self.prefill_buckets))
                 self.watchdog.watch(f"{obs_ns}.prefill_finish",
                                     self._jit_prefill_finish, budget=1)
+            if self._jit_reset_scales is not None:
+                self.watchdog.watch(f"{obs_ns}.reset_scales",
+                                    self._jit_reset_scales, budget=1)
 
     # ------------------------------------------------------------------- jits
     def _build_jits(self):
@@ -652,6 +743,11 @@ class ServingEngine:
         prefill compiles at most once per bucket)."""
         model, dtype = self.model, self.cache_dtype
         n_latents = model.max_latents
+        # weight serving (serving/quant.py): int8 trees dequantize as the
+        # FIRST op of every params-consuming program — the resident tree
+        # stays int8, the dequantized copy is a per-execution transient.
+        # Identity for weight_dtype None/bf16: the traces are untouched.
+        dq = self._dequant_params
 
         @partial(jax.jit, static_argnames=("bucket",))
         def prefill_one(params, ids, pad_mask, bucket):
@@ -659,6 +755,7 @@ class ServingEngine:
             # O(bucket), and the bucket always yields exactly max_latents
             # latents (prefix_len = bucket - max_latents) so the pool's
             # shared self-attention length stays uniform
+            params = dq(params)
             cache = model.init_cache(batch_size=1, dtype=dtype, max_seq_len=bucket)
             logits, cache = model.apply(
                 params, ids, bucket - n_latents, cache, pad_mask=pad_mask, method=type(model).prefill
@@ -762,7 +859,7 @@ class ServingEngine:
             # f64 parity pins run through it.
             tok = jnp.where(use_forced, forced, tok).astype(jnp.int32)
             logits_t, cache = model.apply(
-                params, tok[:, None], cache, method=decode_method
+                dq(params), tok[:, None], cache, method=decode_method
             )
             # inactive rows keep their (zeroed-at-release) rng/logits frozen:
             # freed-slot state stays canonical across steps, so pool dumps are
@@ -802,19 +899,33 @@ class ServingEngine:
             # for the next tenant (gathered at softmax weight 0), but a NaN
             # would poison the sum through 0 * NaN — the same reason the
             # dense quarantine zeroes its rows. O(pages), not O(window *
-            # slots), and only on the containment path.
+            # slots), and only on the containment path. Quantized pools zero
+            # the SCALE sidecars too (reset_page_scales): a NaN that reached
+            # the quantizer lands in the scale, and dequant multiplies every
+            # byte of the page by it — int8 bytes alone are not the poison.
             ca = cache.ca
+            ca = ca.replace(
+                kp=ca.kp.at[table_row].set(0), vp=ca.vp.at[table_row].set(0)
+            ).reset_page_scales(table_row)
             return cache.replace(
-                ca=ca.replace(
-                    kp=ca.kp.at[table_row].set(0), vp=ca.vp.at[table_row].set(0)
-                ),
+                ca=ca,
                 sa=cache.sa.replace(
                     k=cache.sa.k.at[:, slot].set(0), v=cache.sa.v.at[:, slot].set(0)
                 ),
             )
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def reset_scales(cache, ids):
+            # quantized split admission: zero the PRIVATE reservation's scale
+            # sidecars before any chunk writes, so a page's first ratcheted
+            # append starts from scale 0 and zeroes stale tenant bytes
+            # (ops/paged_decode_kernel.reset_page_scales). Shared prefix
+            # pages are never in ``ids`` — their scales belong to the cache.
+            return cache.replace(ca=cache.ca.reset_page_scales(ids))
+
         @partial(jax.jit, donate_argnums=(1,))
-        def chunk_kv(params, cache, ids, offset, count, latent_start, table_row):
+        def chunk_kv(params_, cache, ids, offset, count, latent_start, table_row):
+            params = dq(params_)
             # one SPLIT-prefill chunk (docs/serving.md "Chunked prefill"):
             # position-wise KV for prompt tokens [offset, offset + count)
             # scattered page-wise through table_row — the slot's IN-CACHE
@@ -834,8 +945,9 @@ class ServingEngine:
             )
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_finish(params, cache, state, slot, table_row, ids, n, rng,
+        def prefill_finish(params_, cache, state, slot, table_row, ids, n, rng,
                            temperature, top_k, top_p, do_sample, pad_id):
+            params = dq(params_)
             # the SPLIT prefill's finish: latents for the last max_latents
             # prompt tokens against the slot's already-written pages, then
             # the install bookkeeping (table, ring offset, SA cache, slot
@@ -857,6 +969,7 @@ class ServingEngine:
         self._jit_quarantine = quarantine_paged if self.paged else quarantine
         self._jit_chunk_kv = chunk_kv if self.paged else None
         self._jit_prefill_finish = prefill_finish if self.paged else None
+        self._jit_reset_scales = reset_scales if self.paged and self.kv_quant else None
 
     @property
     def decode_compilations(self) -> int:
@@ -882,6 +995,8 @@ class ServingEngine:
             jits.append(self._jit_release_pages)
         if self._jit_chunk_kv is not None:
             jits.extend((self._jit_chunk_kv, self._jit_prefill_finish))
+        if self._jit_reset_scales is not None:
+            jits.append(self._jit_reset_scales)
         return sum(f._cache_size() for f in jits)
 
     # -------------------------------------------------------------- capacity
@@ -1189,8 +1304,20 @@ class ServingEngine:
             shared_run: List[int] = []
             if self._prefix_cache is not None and request.page_keys:
                 shared_run = self._prefix_cache.probe(request.page_keys)
+            # QUANTIZED pools route every prompt that fits the finish step
+            # (n >= max_latents) through the split path, cold or cache-hit:
+            # the finish computes its latents against the slot's QUANTIZED
+            # pages (gather_slot dequant), so a cache-hit fork and a cold
+            # admission of the same prompt see byte-identical KV — the
+            # cache-on == cache-off token identity the fp engine pins
+            # survives quantization. (The classic one-shot path computes
+            # latents inside the prefill program, BEFORE quantization —
+            # fp-exact KV a fork could never reproduce from shared pages.)
+            # Shorter prompts (n < max_latents) keep the classic path: they
+            # have no cacheable pages, so no identity is at stake.
             if shared_run or (self.chunked and n >= self._latents
-                              and n > self.prefill_chunk_tokens):
+                              and n > self.prefill_chunk_tokens) or (
+                                  self.kv_quant is not None and n >= self._latents):
                 self._admit_split(slot, request, bucket, shared_run, t0)
                 return
             # the ONLY allocation point (serving/paging.py): the whole
@@ -1291,6 +1418,15 @@ class ServingEngine:
         self._slot_pages[slot] = page_ids
         table_row = np.zeros((self._pages_per_slot,), np.int32)
         table_row[: len(page_ids)] = page_ids  # trash-padded reservation
+        if self._jit_reset_scales is not None:
+            # quantized pools: zero the PRIVATE pages' scale sidecars before
+            # any chunk writes them — a fresh page must start from scale 0 so
+            # its first ratcheted append zeroes stale tenant bytes; shared
+            # prefix pages keep theirs (the scales ARE part of the cached
+            # bytes). Trash-padded tail entries re-zero page 0 harmlessly.
+            ids_row = np.zeros((self._pages_per_slot,), np.int32)
+            ids_row[: len(private)] = private
+            self._cache = self._jit_reset_scales(self._cache, jnp.asarray(ids_row))
         shared_tokens = shared * self.kv_page_size
         budget = (self.prefill_chunk_tokens if self.chunked
                   else max(n - shared_tokens, 1))

@@ -92,6 +92,21 @@ Schema history:
     normalizes pre-v8 snapshots with ``None`` for both sections — "not
     recorded" stays distinguishable from "feature off", the v2→v3
     discipline throughout.
+  * ``serving-metrics/v9`` — the quantized-serving schema (docs/serving.md
+    "Quantized KV pages & weight serving"): every snapshot carries a
+    ``kv_quant`` field — ``None`` on engines serving full-precision pages
+    (and on router snapshots — pools are per-engine, the replica sections
+    carry the real gauges), else ``mode`` ("int8"), ``bytes_per_token_fp``
+    / ``bytes_per_token`` (K+V bytes one resident token costs,
+    full-precision vs quantized, per-page-per-head scale sidecars
+    amortized over the page), and greedy-agreement sample counters
+    ``agreement_tokens`` / ``agreement_matched`` / ``agreement_rate``
+    (populated by harnesses running a quantized arm against an fp
+    reference — ``serve_bench --kv-quant``; rate ``None`` when unsampled) —
+    and a ``weight_serving`` field — ``None`` when params are served
+    untouched, else ``dtype`` ("bf16"|"int8") / ``param_bytes`` /
+    ``param_bytes_fp``. The reader normalizes pre-v9 snapshots with
+    ``None`` for both sections — the v2→v3 discipline throughout.
 """
 
 from __future__ import annotations
@@ -104,7 +119,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v8"
+SCHEMA = "serving-metrics/v9"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -114,15 +129,18 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v6",
     "serving-metrics/v7",
     "serving-metrics/v8",
+    "serving-metrics/v9",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
 _V6_FIELDS = ("preemptions", "preempted_replays", "queue_wait_by_priority")
 _V8_FIELDS = ("prefix_cache", "chunked_prefill")
+_V9_FIELDS = ("kv_quant", "weight_serving")
 _PRE_V5 = KNOWN_SCHEMAS[:4]
 _PRE_V6 = KNOWN_SCHEMAS[:5]
 _PRE_V7 = KNOWN_SCHEMAS[:6]
 _PRE_V8 = KNOWN_SCHEMAS[:7]
+_PRE_V9 = KNOWN_SCHEMAS[:8]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -211,6 +229,12 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # prefill: None, NOT 0 — "not recorded" must stay
                 # distinguishable from "feature off / nothing happened"
                 for k in _V8_FIELDS:
+                    snap.setdefault(k, None)
+            if schema in _PRE_V9:
+                # pre-v9 writers served full-precision pages and untouched
+                # params; None also matches a newer fp engine's truthful
+                # "quantization off"
+                for k in _V9_FIELDS:
                     snap.setdefault(k, None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
@@ -314,6 +338,16 @@ class EngineMetrics(_JsonlMetrics):
     chunk_tokens: Optional[int] = None
     chunks_dispatched: int = 0
     chunked_admissions: int = 0
+    # quantized-serving gauges (serving-metrics/v9): mode None <=> fp pages
+    # and snapshots report kv_quant: None; agreement counters are fed by
+    # quant-vs-fp harnesses (serve_bench --kv-quant), 0/unsampled otherwise
+    kv_quant_mode: Optional[str] = None
+    kv_bytes_per_token_fp: Optional[float] = None
+    kv_bytes_per_token: Optional[float] = None
+    agreement_tokens: int = 0
+    agreement_matched: int = 0
+    # weight-serving gauges (serving-metrics/v9): None <=> params untouched
+    weight_serving: Optional[Dict] = None
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
     _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -403,6 +437,34 @@ class EngineMetrics(_JsonlMetrics):
         """Mark chunked admission active (serving-metrics/v8): snapshots
         report the chunked_prefill section instead of None."""
         self.chunk_tokens = chunk_tokens
+
+    def set_kv_quant(self, mode: str, bytes_per_token_fp: float,
+                     bytes_per_token: float) -> None:
+        """Mark quantized KV pages active (serving-metrics/v9): snapshots
+        report the kv_quant section — mode plus the per-token KV byte
+        economics (scale sidecars amortized) — instead of None."""
+        self.kv_quant_mode = mode
+        self.kv_bytes_per_token_fp = round(bytes_per_token_fp, 3)
+        self.kv_bytes_per_token = round(bytes_per_token, 3)
+
+    def record_quant_agreement(self, matched: int, total: int) -> None:
+        """Fold one greedy-agreement sample batch into the v9 counters: a
+        harness decoded ``total`` tokens on this quantized engine against an
+        fp reference and ``matched`` of them agreed (serve_bench --kv-quant
+        feeds this before its terminal snapshot — the agreement rate then
+        rides the snapshot instead of living only in a bench artifact)."""
+        self.agreement_matched += int(matched)
+        self.agreement_tokens += int(total)
+        self._emit("quant_agreement", matched=int(matched), total=int(total))
+
+    def set_weight_serving(self, dtype: str, param_bytes: int,
+                           param_bytes_fp: int) -> None:
+        """Mark the weight-serving transform active (serving-metrics/v9)."""
+        self.weight_serving = {
+            "dtype": dtype,
+            "param_bytes": int(param_bytes),
+            "param_bytes_fp": int(param_bytes_fp),
+        }
 
     def record_preempt(self, request_id: int, slot: int, preempted_by: int,
                        pages_freed: int, emitted_tokens: int,
@@ -573,6 +635,20 @@ class EngineMetrics(_JsonlMetrics):
                 "chunks_dispatched": self.chunks_dispatched,
                 "chunked_admissions": self.chunked_admissions,
             },
+            # v9: None on fp-page engines / untouched params (same reading
+            # as a pre-v9 snapshot), the quantized-serving gauges otherwise
+            "kv_quant": None if self.kv_quant_mode is None else {
+                "mode": self.kv_quant_mode,
+                "bytes_per_token_fp": self.kv_bytes_per_token_fp,
+                "bytes_per_token": self.kv_bytes_per_token,
+                "agreement_tokens": self.agreement_tokens,
+                "agreement_matched": self.agreement_matched,
+                "agreement_rate": round(
+                    self.agreement_matched / self.agreement_tokens, 4
+                ) if self.agreement_tokens else None,
+            },
+            "weight_serving": None if self.weight_serving is None
+            else dict(self.weight_serving),
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -696,13 +772,16 @@ class RouterMetrics(_JsonlMetrics):
                 s.get("preempted_replays") or 0 for s in replicas.values()
             ),
             "queue_wait_by_priority": None,
-            # pools, journals, prefix caches, and chunked admission are
-            # per-engine: the embedded replica sections carry the real
-            # gauges, the router itself truthfully has none of them
+            # pools, journals, prefix caches, chunked admission, and the
+            # quantized-serving modes are per-engine: the embedded replica
+            # sections carry the real gauges, the router itself truthfully
+            # has none of them
             "page_pool": None,
             "journal": None,
             "prefix_cache": None,
             "chunked_prefill": None,
+            "kv_quant": None,
+            "weight_serving": None,
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),
             "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,

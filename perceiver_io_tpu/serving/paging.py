@@ -76,6 +76,17 @@ def prefix_cache_enabled() -> bool:
     return os.environ.get("PERCEIVER_IO_TPU_DISABLE_PREFIX_CACHE", "0").lower() in ("0", "false", "")
 
 
+def kv_quant_enabled() -> bool:
+    """Kill-switch for quantized serving (docs/serving.md "Quantized KV
+    pages & weight serving"): ``PERCEIVER_IO_TPU_DISABLE_KV_QUANT=1`` forces
+    full-precision pages AND full-precision served weights regardless of the
+    engine's ``kv_quant``/``weight_dtype`` knobs — behavior exactly the
+    pre-quantization engine's (f64 parity pinned, tests/test_kv_quant.py).
+    Checked at engine construction, like the paged-KV switch; a rollback
+    lever must never crash the engine it rolls back."""
+    return os.environ.get("PERCEIVER_IO_TPU_DISABLE_KV_QUANT", "0").lower() in ("0", "false", "")
+
+
 def chunked_prefill_enabled() -> bool:
     """Kill-switch for chunked admission prefill:
     ``PERCEIVER_IO_TPU_DISABLE_CHUNKED_PREFILL=1`` pins every admission to
@@ -262,9 +273,18 @@ class PrefixCache:
     counter driven solely by the probe/insert history.
     """
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int,
+                 kv_quant: Optional[str] = None):
+        # the cache's pages carry the POOL'S byte layout: int8 + scale
+        # sidecars under kv_quant, full-precision rows otherwise. The mode is
+        # part of the cache's identity — a pool toggled between runs must
+        # never serve int8 pages to an fp reader (or vice versa), so the
+        # engine validates its own mode against the cache it builds
+        # (``ensure_mode``) and any future persisted/shared cache must carry
+        # the mode with its keys.
         self.pool = pool
         self.page_size = page_size
+        self.kv_quant = kv_quant
         self._children: Dict[tuple, _TrieNode] = {}  # root's children
         self._nodes: Set[_TrieNode] = set()  # flat view for eviction scans
         self._clock = itertools.count()
@@ -276,6 +296,19 @@ class PrefixCache:
         self.evictions = 0  # eviction EPISODES (an evict() call that freed)
 
     # ------------------------------------------------------------------ state
+    def ensure_mode(self, kv_quant: Optional[str]) -> None:
+        """Validate that a reader's quantization mode matches the bytes this
+        cache's pages hold (the quant × prefix-cache seam, docs/serving.md):
+        an fp reader handed int8 pages would gather garbage magnitudes, a
+        quantized reader handed fp pages would mis-scale every prefix — both
+        silent wrong-KV outcomes, so a mismatch REJECTS loudly instead."""
+        if kv_quant != self.kv_quant:
+            raise ValueError(
+                f"prefix cache holds {self.kv_quant or 'full-precision'} pages "
+                f"but the reader runs {kv_quant or 'full-precision'} — a cache "
+                "never serves pages across quantization modes"
+            )
+
     @property
     def cached_pages(self) -> int:
         return len(self._nodes)

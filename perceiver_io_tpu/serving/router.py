@@ -70,7 +70,7 @@ Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v8``:
+phase tables from the single trace. Metrics are ``serving-metrics/v9``:
 router snapshots embed per-replica engine snapshots, the
 failover/shed/breaker counters, and the aggregated preemption counters
 (request ``priority`` is forwarded to engines; engine-local preemption under
@@ -267,6 +267,8 @@ class ServingRouter:
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: bool = False,
         max_prefill_slots: Optional[int] = None,
+        kv_quant: Optional[str] = None,
+        weight_dtype: Optional[str] = None,
         priority_aging_ticks: Optional[int] = None,
         max_preemptions: int = 2,
         journal: Optional[str] = None,
@@ -358,6 +360,18 @@ class ServingRouter:
                     prefill_chunk_tokens=prefill_chunk_tokens,
                     prefix_cache=prefix_cache,
                     max_prefill_slots=max_prefill_slots,
+                    # quantized serving is per-replica like the pool it
+                    # shrinks (docs/serving.md "Quantized KV pages & weight
+                    # serving"): every replica serves the same byte layout,
+                    # so a failover replay re-quantizes the victim's prompt
+                    # + emitted tokens on the NEW replica's pool through the
+                    # same deterministic write paths — the continuation is
+                    # token-identical to an uncontended quantized run
+                    # (pinned, tests/test_router.py). weight_dtype likewise:
+                    # each replica holds its own served (cast/quantized)
+                    # copy of the params.
+                    kv_quant=kv_quant,
+                    weight_dtype=weight_dtype,
                     # priority/preemption policy is per-engine (each replica
                     # preempts over its own slots and pool); the router only
                     # forwards classes and reads the aggregated counters
@@ -1079,7 +1093,7 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v8 router snapshot with per-replica sections."""
+        """serving-metrics/v9 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:

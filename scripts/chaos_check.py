@@ -377,6 +377,52 @@ def check_serving_nan() -> dict:
     }
 
 
+def check_quant_quarantine() -> dict:
+    """NaN containment on an int8-QUANTIZED page pool (docs/serving.md
+    "Quantized KV pages & weight serving"): the poisoned slot is evicted
+    FAILED, its pages' int8 BYTES *and* their per-page-per-head SCALE
+    sidecars are zeroed before the pages return to the free list (a NaN that
+    reached the quantizer lands in the scale, and dequant multiplies every
+    byte of the page by it — zeroing bytes alone would leave the poison),
+    and slot-mates decode on BIT-identical to an unpoisoned quantized run."""
+    model, params = _serving_setup()
+    kw = dict(num_slots=2, kv_page_size=4, kv_quant="int8")
+    ref = _greedy_tokens(_engine(model, params, **kw), [[4, 5, 6]])[0]
+    engine = _engine(model, params, **kw)
+    poisoned = engine.submit(list(range(1, 10)), max_new_tokens=6)
+    survivor = engine.submit([4, 5, 6], max_new_tokens=5)
+    engine.step()  # both admitted, one token decoded
+    condemned_pages = [p for p in (engine._slot_pages[poisoned.slot] or [])]
+    with armed("serving.nan", slot=poisoned.slot):
+        engine.step()
+    engine.run_until_drained(max_steps=100)
+    snap = engine.metrics.snapshot()
+    ca = engine._cache.ca
+    kp, vp = np.asarray(ca.kp), np.asarray(ca.vp)
+    ks, vs = np.asarray(ca.k_scale), np.asarray(ca.v_scale)
+    bytes_zeroed = bool((kp[condemned_pages] == 0).all()
+                        and (vp[condemned_pages] == 0).all())
+    scales_zeroed = bool((ks[condemned_pages] == 0).all()
+                         and (vs[condemned_pages] == 0).all())
+    scales_finite = bool(np.isfinite(ks).all() and np.isfinite(vs).all())
+    return {
+        "ok": (
+            poisoned.status.value == "failed"
+            and survivor.ok
+            and survivor.result().tolist() == ref.result().tolist()
+            and snap["failed"] == 1
+            and snap["kv_quant"] is not None
+            and bytes_zeroed and scales_zeroed and scales_finite
+            and engine._pool.pages_in_use == 0
+        ),
+        "poisoned": poisoned.status.value,
+        "survivor_identical": survivor.result().tolist() == ref.result().tolist(),
+        "condemned_bytes_zeroed": bytes_zeroed,
+        "condemned_scales_zeroed": scales_zeroed,
+        "scales_finite": scales_finite,
+    }
+
+
 def check_queue_bound() -> dict:
     model, params = _serving_setup()
     engine = _engine(model, params, num_slots=1, max_queue_depth=1)
@@ -1036,6 +1082,7 @@ CHECKS = {
     "serving_deadline": check_serving_deadline,
     "serving_nan": check_serving_nan,
     "queue_bound": check_queue_bound,
+    "quant_quarantine": check_quant_quarantine,
     "paging_pool_exhaustion": check_paging_pool_exhaustion,
     "preempt_storm": check_preempt_storm,
     "preempt_disabled_inert": check_preempt_disabled_inert,

@@ -252,6 +252,10 @@ def report_serving_metrics(path: str) -> Dict:
         # feature off, router snapshot, or pre-v8 stream)
         out["prefix_cache"] = snap.get("prefix_cache")
         out["chunked_prefill"] = snap.get("chunked_prefill")
+        # serving-metrics/v9 quantized-serving gauges (None: fp pages /
+        # untouched params, router snapshot, or pre-v9 stream)
+        out["kv_quant"] = snap.get("kv_quant")
+        out["weight_serving"] = snap.get("weight_serving")
         prefix_hits = [e for e in loaded["events"] if e.get("event") == "prefix_hit"]
         if prefix_hits:
             out["prefix_hit_events"] = {
@@ -354,6 +358,25 @@ def main(argv=None) -> Dict:
                   f"{pool.get('pages_in_use')}/{pool.get('pages_total')} pages in use, "
                   f"pages/request p50={ppr.get('p50')} p95={ppr.get('p95')}, "
                   f"alloc failures={pool.get('alloc_failures')}")
+        # v9 quantized-serving rendering (suppressed where the reader
+        # normalized to None: quant off, router snapshot, pre-v9 stream) —
+        # the HBM split KV-vs-weights an operator sizes a chip against
+        kvq = section.get("kv_quant")
+        if kvq:
+            rate = kvq.get("agreement_rate")
+            print("kv quant: "
+                  f"mode={kvq.get('mode')}, "
+                  f"{kvq.get('bytes_per_token')}/{kvq.get('bytes_per_token_fp')} "
+                  f"KV bytes/token (quant/fp), greedy agreement "
+                  f"{'unsampled' if rate is None else format(rate, '.2%')} "
+                  f"({kvq.get('agreement_matched')}/{kvq.get('agreement_tokens')} tokens)")
+        ws = section.get("weight_serving")
+        if ws:
+            fp_b = ws.get("param_bytes_fp") or 0
+            served = ws.get("param_bytes") or 0
+            ratio = f"{served / fp_b:.2f}x fp" if fp_b else "n/a"
+            print("weight serving: "
+                  f"dtype={ws.get('dtype')}, params {served} bytes ({ratio})")
         # v7 journal health + recovery rendering (suppressed on journal-less
         # engines and pre-v7 streams, where the reader normalized to None)
         jstats = section.get("journal")

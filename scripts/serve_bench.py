@@ -21,6 +21,15 @@ budget and admission tokens/s, paged pool vs dense pool, interleaved
 median-of-``--page-repeats``; the block is merged into the ``--profile-out``
 artifact (BENCH_serving.json) with its run manifest.
 
+``--kv-quant N`` runs the quantized-KV capacity arm (ROADMAP item 3,
+docs/serving.md "Quantized KV pages & weight serving"): concurrent sessions
+per fixed pool BYTE budget, int8 pages (+ per-page-per-head scale sidecars,
+counted inside the budget) vs full-precision pages at page size N —
+interleaved median-of-``--kv-quant-repeats`` — with greedy-token agreement
+between the arms, a ``kv_quant=None`` pre-quant byte-identity pin, and
+measured bf16/int8 weight-serving bytes + teacher-forced CE deltas; the
+block is merged into the ``--profile-out`` artifact (BENCH_serving.json).
+
 ``--priority-arm`` runs the mixed-priority overload arm (docs/serving.md
 "Priority classes & preemption"): a saturating low-priority background plus
 high-priority foreground through a page-constrained engine, preemption ON vs
@@ -410,6 +419,214 @@ def run_paging_capacity(model, config, params, page_size: int, num_slots: int,
         # f64 identity is the pinned contract (tests/test_paging.py); this is
         # the f32 observation on the LAST interleaved pass
         "greedy_tokens_identical_f32": tokens_by_arm["dense"] == tokens_by_arm["paged"],
+    }
+
+
+def run_kv_quant_capacity(model, config, params, page_size: int, num_slots: int,
+                          seed: int, repeats: int = 7, max_new: int = 8) -> dict:
+    """Acceptance arm (ROADMAP item 3 / docs/serving.md "Quantized KV pages
+    & weight serving"): CONCURRENT SESSIONS PER FIXED POOL BYTE BUDGET,
+    int8-quantized pages vs full-precision pages — both PAGED, so the ratio
+    isolates what quantization alone buys on top of PR 8's paging win. The
+    budget is the fp arm's pool bytes (``num_slots`` worth of default paged
+    reservations, trash page included); the int8 arm spends the exact same
+    bytes on int8 pages + their per-page-per-head f32 scale sidecars
+    (honestly counted inside the budget) and raises its slot count to what
+    the bigger pool holds resident for this workload's worst-case
+    reservation.
+
+    Measured per arm, interleaved median-of-``repeats``: peak concurrent
+    RUNNING sessions, admission prompt tokens/s (wall to the LAST admission),
+    TTFT p95, and drain tokens/s. Quality is NOT silently dropped: the block
+    reports greedy token agreement between the arms (token-level rate, exact
+    sequence match fraction, recorded into the quant engine's v9 snapshot
+    via ``record_quant_agreement``) and a weight-serving section with
+    measured param bytes + teacher-forced CE deltas for bf16/int8 weights vs
+    fp32 on a synthetic batch (the cheap stand-in for the convergence/CE
+    harness gate — methodology in docs/serving.md). A ``kv_quant=None``
+    engine is additionally pinned byte-identical to one constructed with the
+    pre-quantization signature."""
+    from perceiver_io_tpu.serving import ServingEngine, pages_for_request
+    from perceiver_io_tpu.serving.engine import default_prefill_buckets
+    from perceiver_io_tpu.serving.quant import dequantize_params, serve_params
+
+    window = config.max_seq_len
+    pages_per_slot = -(-window // page_size)
+    num_pages_fp = num_slots * pages_per_slot + 1
+    fp_itemsize = 4  # the engines below run f32 pools (the serving default)
+    page_bytes_fp = 2 * page_size * config.num_channels * fp_itemsize
+    page_bytes_q = (2 * page_size * config.num_channels  # int8 KV bytes
+                    + 2 * config.num_heads * 4)  # f32 scale sidecars
+    budget_bytes = num_pages_fp * page_bytes_fp
+    num_pages_q = budget_bytes // page_bytes_q
+
+    rng = np.random.RandomState(seed)
+    short_hi = max(window // 8, 2)
+    buckets = default_prefill_buckets(window, config.max_latents)
+    covering = next(b for b in buckets if b >= short_hi)
+    need = pages_for_request(covering, max_new, window, page_size)
+    # BOTH arms raise their slot count to what their own pool holds resident
+    # for this workload's worst-case reservation — the ratio then isolates
+    # what the BYTES buy, not slot-count generosity (each extra slot still
+    # costs max_latents SA rows outside the pool budget, reported below —
+    # the same honesty note as the paging arm)
+    slots_fp = max((num_pages_fp - 1) // need, 1)
+    slots_q = max((num_pages_q - 1) // need, 1)
+
+    k = 2 * max(slots_q, slots_fp)
+    prompts = [rng.randint(1, config.vocab_size, size=int(n)).tolist()
+               for n in rng.randint(2, short_hi + 1, size=k)]
+
+    # telemetry=False: ambient env must not record inside a TIMED arm
+    engines = {
+        "fp": ServingEngine(model, params, num_slots=slots_fp,
+                            kv_page_size=page_size, num_kv_pages=num_pages_fp,
+                            telemetry=False),
+        "int8": ServingEngine(model, params, num_slots=slots_q,
+                              kv_page_size=page_size, num_kv_pages=num_pages_q,
+                              kv_quant="int8", telemetry=False),
+    }
+
+    def one_pass(engine):
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, max_new_tokens=max_new, rng=jax.random.PRNGKey(i))
+                   for i, p in enumerate(prompts)]
+        peak = 0
+        while engine.step():
+            peak = max(peak, engine.scheduler.active_slots)
+        drain_wall = time.perf_counter() - t0
+        assert all(h.ok for h in handles)  # a degraded pass must not be timed
+        admit_wall = max(h.admitted_at for h in handles) - t0
+        ttft = sorted(h.admitted_at - h.submitted_at for h in handles)
+        engine.finished.clear()
+        return peak, admit_wall, drain_wall, ttft, [h.result().tolist() for h in handles]
+
+    for engine in engines.values():  # warmup compiles every covering bucket
+        one_pass(engine)
+    peaks = {n: [] for n in engines}
+    admit_walls = {n: [] for n in engines}
+    drain_walls = {n: [] for n in engines}
+    ttft_p95s = {n: [] for n in engines}
+    tokens_by_arm = {}
+    for _ in range(repeats):
+        for name, engine in engines.items():  # interleaved A/B
+            peak, admit, drain, ttft, toks = one_pass(engine)
+            peaks[name].append(peak)
+            admit_walls[name].append(admit)
+            drain_walls[name].append(drain)
+            ttft_p95s[name].append(_pct(ttft, 0.95))
+            tokens_by_arm[name] = toks
+
+    # greedy-token agreement, int8 arm vs fp arm (identical prompts/rngs):
+    # the serving-relevant quality number — recorded into the quant engine's
+    # v9 snapshot so the agreement rate rides serving-metrics, not only this
+    # artifact
+    total = matched = exact = diverge_steps = 0
+    for a, b in zip(tokens_by_arm["fp"], tokens_by_arm["int8"]):
+        total += max(len(a), len(b))
+        matched += sum(1 for x, y in zip(a, b) if x == y)
+        exact += a == b
+        first_div = next((i for i, (x, y) in enumerate(zip(a, b)) if x != y),
+                         min(len(a), len(b)))
+        diverge_steps += first_div
+    engines["int8"].metrics.record_quant_agreement(matched, total)
+
+    prompt_tokens = sum(len(p) for p in prompts)
+    new_tokens = max_new * len(prompts)
+    arms = {}
+    for name, engine in engines.items():
+        admit, drain = _median(admit_walls[name]), _median(drain_walls[name])
+        snap = engine.metrics.snapshot()
+        arms[name] = {
+            "slots": engine.num_slots,
+            "num_kv_pages": engine._pool.num_pages,
+            "pool_bytes": (num_pages_fp * page_bytes_fp if name == "fp"
+                           else num_pages_q * page_bytes_q),
+            "peak_concurrent_sessions": _median(peaks[name]),
+            "admission_wall_seconds": round(admit, 4),
+            "admission_prompt_tokens_per_s": round(prompt_tokens / admit, 2)
+            if admit > 0 else 0.0,
+            "ttft_p95_seconds": round(_median(ttft_p95s[name]), 4),
+            "drain_wall_seconds": round(drain, 4),
+            "tokens_per_s": round(new_tokens / drain, 2) if drain > 0 else 0.0,
+            "decode_compilations": engine.decode_compilations,
+            "kv_quant": snap["kv_quant"],
+        }
+        engine.close()
+
+    # kv_quant=None byte-identity: an engine with the knob explicitly None
+    # produces exactly the tokens of one constructed with the PRE-quant
+    # signature (no quant kwargs at all) — the off-path really is the old
+    # engine (acceptance criterion; the f64 pin lives in tests/test_kv_quant)
+    def _identity_tokens(**kw):
+        eng = ServingEngine(model, params, num_slots=num_slots,
+                            kv_page_size=page_size,
+                            num_kv_pages=num_pages_fp, telemetry=False, **kw)
+        hs = [eng.submit(p, max_new_tokens=max_new, rng=jax.random.PRNGKey(i))
+              for i, p in enumerate(prompts[: 2 * num_slots])]
+        eng.run_until_drained(max_steps=20_000)
+        eng.close()
+        return [h.result().tolist() for h in hs]
+
+    none_identical = (_identity_tokens(kv_quant=None, weight_dtype=None)
+                      == _identity_tokens())
+
+    # weight-serving quality/bytes: teacher-forced CE on one synthetic batch,
+    # computed through the SAME transform the engine applies (int8 leaves
+    # dequantized exactly as the engine's jits do on entry)
+    eval_rng = np.random.RandomState(seed + 1)
+    ids = jnp.asarray(eval_rng.randint(1, config.vocab_size,
+                                       size=(2, window)), jnp.int32)
+    prefix_len = window - config.max_latents
+
+    def _ce(tree):
+        logits = model.apply(tree, ids, prefix_len)
+        targets = ids[:, prefix_len + 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)
+        return float(jnp.mean(nll))
+
+    ce_fp = _ce(params)
+    weight_arms = {"fp32": {"param_bytes": serve_params(params, None)[2],
+                            "ce": round(ce_fp, 6), "ce_delta": 0.0}}
+    for wd in ("bf16", "int8"):
+        served, _dq, served_bytes, _fp_bytes = serve_params(params, wd)
+        tree = dequantize_params(served) if wd == "int8" else served
+        ce = _ce(tree)
+        weight_arms[wd] = {
+            "param_bytes": served_bytes,
+            "ce": round(ce, 6),
+            "ce_delta": round(ce - ce_fp, 6),
+        }
+
+    fp, q = arms["fp"], arms["int8"]
+    return {
+        "page_size": page_size,
+        "window": window,
+        "pool_byte_budget": budget_bytes,
+        "page_bytes_fp": page_bytes_fp,
+        "page_bytes_int8": page_bytes_q,
+        "requests": len(prompts),
+        "max_new_tokens": max_new,
+        "prompt_tokens_per_pass": prompt_tokens,
+        # self-attention state each slot costs OUTSIDE the pool budget (the
+        # paging arm's honesty note: the budget covers the dominant CA term)
+        "sa_rows_per_slot": config.max_latents,
+        **{f"{n}_arm": a for n, a in arms.items()},
+        "concurrent_sessions_ratio": round(
+            q["peak_concurrent_sessions"] / fp["peak_concurrent_sessions"], 3
+        ) if fp["peak_concurrent_sessions"] else 0.0,
+        "admission_speedup": round(
+            q["admission_prompt_tokens_per_s"] / fp["admission_prompt_tokens_per_s"], 3
+        ) if fp["admission_prompt_tokens_per_s"] > 0 else 0.0,
+        "quality": {
+            "greedy_token_agreement": round(matched / total, 4) if total else None,
+            "exact_sequence_match": round(exact / len(prompts), 4),
+            "mean_first_divergence_step": round(diverge_steps / len(prompts), 2),
+            "compared_tokens": total,
+        },
+        "kv_quant_none_identical_to_pre_quant": none_identical,
+        "weight_serving": weight_arms,
     }
 
 
@@ -1177,6 +1394,15 @@ def main(argv=None) -> dict:
                          "the block lands in the --profile-out artifact "
                          "(BENCH_serving.json)")
     ap.add_argument("--page-repeats", type=int, default=7)
+    ap.add_argument("--kv-quant", type=int, default=0, metavar="PAGE_SIZE",
+                    help="run the quantized-KV capacity arm: concurrent "
+                         "sessions per fixed pool BYTE budget, int8 pages "
+                         "(+ scale sidecars) vs full-precision pages at this "
+                         "page size, interleaved median-of --kv-quant-repeats, "
+                         "with greedy-token agreement + weight-serving CE "
+                         "deltas reported; the block lands in the "
+                         "--profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--kv-quant-repeats", type=int, default=7)
     ap.add_argument("--priority-arm", action="store_true",
                     help="run the mixed-priority overload arm: saturating "
                          "low-priority background + high-priority foreground, "
@@ -1223,6 +1449,13 @@ def main(argv=None) -> dict:
     def paging_arm(model, config, params):
         block = run_paging_capacity(model, config, params, args.page_size,
                                     args.slots, args.seed, repeats=args.page_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def kv_quant_arm(model, config, params):
+        block = run_kv_quant_capacity(model, config, params, args.kv_quant,
+                                      args.slots, args.seed,
+                                      repeats=args.kv_quant_repeats)
         block["preset"] = args.preset
         return block
 
@@ -1303,6 +1536,8 @@ def main(argv=None) -> dict:
             result["replica_scaling"] = replica_arm(model, config, profile_params)
         if args.page_size > 0:
             result["paging"] = paging_arm(model, config, profile_params)
+        if args.kv_quant > 0:
+            result["kv_quant"] = kv_quant_arm(model, config, profile_params)
         if args.priority_arm:
             result["priority_preemption"] = priority_arm(model, config, profile_params)
         if args.journal:
@@ -1363,6 +1598,10 @@ def main(argv=None) -> dict:
         paging = paging_arm(model, config, params)
         result["paging"] = paging
         merge_section("paging", paging, result["recorded_at"])
+    if args.kv_quant > 0:
+        block = kv_quant_arm(model, config, params)
+        result["kv_quant"] = block
+        merge_section("kv_quant", block, result["recorded_at"])
     if args.priority_arm:
         priority = priority_arm(model, config, params)
         result["priority_preemption"] = priority

@@ -1,6 +1,6 @@
 """Multi-replica router tests: dispatch parity, deterministic failover
 (token-identity at bucket boundaries, float64), circuit-breaker state
-machine, SLO shedding, churn/compile bounds, serving-metrics/v8, and the
+machine, SLO shedding, churn/compile bounds, serving-metrics/v9, and the
 SIGTERM/SIGINT graceful drain.
 
 The failover contract (docs/serving.md, router section): after a replica is
@@ -83,7 +83,7 @@ def test_router_greedy_parity_mixed_lengths(x64):
         assert handle.failovers == 0
     # load-based dispatch actually spread the work
     snap = router.snapshot()
-    assert snap["schema"] == "serving-metrics/v8"
+    assert snap["schema"] == "serving-metrics/v9"
     assert all(s["requests_admitted"] > 0 for s in snap["replicas"].values())
     assert snap["failovers"] == 0 and snap["breaker_transitions"] == {}
     router.close()
@@ -160,6 +160,53 @@ def test_paged_failover_replays_at_victims_page_count(x64):
     assert snap["page_pool"] is None  # router has no pool of its own
     assert snap["replicas"][f"r{victim.replica}"]["page_pool"]["pages_in_use"] == 0
     router.close()
+
+
+def test_quantized_fleet_failover_token_identity(x64):
+    """Satellite (docs/serving.md "Quantized KV pages & weight serving"):
+    the router forwards ``kv_quant``/``weight_dtype`` per-replica, and a
+    failover replay across an int8-quantized fleet is token-identical to an
+    UNCONTENDED quantized single-engine run — the replay re-quantizes the
+    victim's prompt + emitted tokens on the new replica's pool through the
+    same deterministic write paths, so the quantization error is replayed
+    byte-for-byte, not merely approximated."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompt, max_new = list(range(3, 12)), 4
+    kw = dict(kv_page_size=3, kv_quant="int8")
+
+    ref_engine = ServingEngine(model, params, num_slots=1, **kw)
+    ref = ref_engine.submit(prompt, max_new_tokens=max_new)
+    ref_engine.run_until_drained(max_steps=200)
+    assert ref.ok
+
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           breaker_cooldown_ticks=1, **kw)
+    assert all(r.engine.kv_quant == "int8" for r in router.replicas)
+    victim = router.submit(prompt, max_new_tokens=max_new)
+    for _ in range(2):
+        router.step()
+    assert len(victim.output_ids) == 2
+    victim_replica = victim.replica
+    with armed("replica.crash", slot=victim_replica, times=1):
+        router.run_until_drained(max_steps=300)
+    assert victim.ok and victim.failovers == 1
+    assert victim.replica != victim_replica
+    assert victim.result().tolist() == ref.result().tolist()
+    snap = router.snapshot()
+    assert snap["kv_quant"] is None  # pools are per-engine; router has none
+    assert snap["replicas"][f"r{victim.replica}"]["kv_quant"]["mode"] == "int8"
+    router.close()
+
+    # weight_dtype forwards the same way (each replica holds its own served
+    # copy); the router itself truthfully reports no weight_serving gauge
+    wrouter = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                            weight_dtype="bf16")
+    assert all(r.engine.weight_dtype == "bf16" for r in wrouter.replicas)
+    wsnap = wrouter.snapshot()
+    assert wsnap["weight_serving"] is None
+    assert all(s["weight_serving"]["dtype"] == "bf16"
+               for s in wsnap["replicas"].values())
+    wrouter.close()
 
 
 def test_failover_bounded_and_partial_output_preserved(x64):
@@ -473,15 +520,15 @@ def test_router_metrics_v4_jsonl_and_reader(tmp_path):
     events = {e["event"] for e in got["events"]}
     assert {"submit", "dispatch", "failover", "breaker", "shed", "finish", "snapshot"} <= events
     snap = got["snapshots"][0]
-    assert snap["schema"] == "serving-metrics/v8"
+    assert snap["schema"] == "serving-metrics/v9"
     assert snap["failovers"] == 1 and snap["shed_infeasible"] == 1
     assert snap["breaker_transitions"] == {"closed->open": 1}
     assert snap["tokens_generated"] == 1  # aggregated over replica sections
     assert set(snap["replicas"]) == {"r0", "r1"}
-    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v8"
+    assert snap["replicas"]["r0"]["schema"] == "serving-metrics/v9"
 
     bad = tmp_path / "bad.jsonl"
-    bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v9"}) + "\n")
+    bad.write_text(json.dumps({"event": "snapshot", "schema": "serving-metrics/v99"}) + "\n")
     with pytest.raises(ValueError, match="unknown metrics schema"):
         load_metrics_jsonl(str(bad))
 
