@@ -40,6 +40,14 @@ tests and ``scripts/chaos_check.py`` arm:
                              compaction/recovery swap; ``slot`` picks the
                              stage (0 = before the atomic rename, 1 = after
                              it, before old-generation deletion)
+  ``router.migrate.kill``    raise ``KilledMidWrite`` inside a planned
+                             cross-replica migration, AFTER the destination's
+                             fsynced accept but BEFORE the origin journal's
+                             close record — the double-live window where the
+                             same session exists in two journals; recovery
+                             must dedupe it to exactly once (the
+                             ``migrate_crash_midflight`` chaos scenario turns
+                             this into a real child-process SIGKILL)
 
 Arming: ``FAULTS.arm(point, after=..., times=..., value=..., slot=...)`` in
 process, or the env ``PERCEIVER_IO_TPU_FAULT="point:key=val,key=val;point2"``
@@ -80,6 +88,7 @@ POINTS = frozenset(
         "serving.journal.torn_write",
         "serving.journal.corrupt_record",
         "serving.journal.compact.kill",
+        "router.migrate.kill",
     }
 )
 
@@ -302,6 +311,22 @@ def fire_journal_compact_kill(stage: int) -> None:
         raise KilledMidWrite(
             f"injected kill mid-journal-compaction (stage {stage}, firing "
             f"{spec.fired}{'' if spec.times is None else f'/{spec.times}'})"
+        )
+
+
+def fire_migrate_kill() -> None:
+    """Planned-migration kill point (serving/router.py ``migrate``): fires in
+    the window AFTER the destination replica journaled its fsynced accept
+    (the continuation is durable there, replay prefix included) and BEFORE
+    the origin journal's close record lands — the only instant the same
+    fleet session is live in TWO journals. Raises ``KilledMidWrite``; the
+    subprocess chaos harness converts it into a real self-SIGKILL so no
+    flush, destructor, or atexit softens the death."""
+    spec = FAULTS.fire("router.migrate.kill")
+    if spec is not None:
+        raise KilledMidWrite(
+            f"injected kill mid-migration (firing {spec.fired}"
+            f"{'' if spec.times is None else f'/{spec.times}'})"
         )
 
 

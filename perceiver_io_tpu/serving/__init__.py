@@ -49,7 +49,11 @@ from perceiver_io_tpu.serving.quant import (
     quantize_params_int8,
     serve_params,
 )
-from perceiver_io_tpu.serving.router import RoutedRequest, ServingRouter
+from perceiver_io_tpu.serving.router import (
+    RoutedRequest,
+    ServingRouter,
+    fleet_ops_enabled,
+)
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
 __all__ = [
@@ -64,6 +68,7 @@ __all__ = [
     "PrefixCache",
     "chunked_prefill_enabled",
     "dequantize_params",
+    "fleet_ops_enabled",
     "kv_quant_enabled",
     "page_keys_for_prompt",
     "paged_kv_enabled",

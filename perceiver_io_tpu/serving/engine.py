@@ -184,6 +184,7 @@ from perceiver_io_tpu.serving.quant import (
     WEIGHT_DTYPES,
     kv_bytes_per_token,
     serve_params,
+    tree_layout_mismatch,
 )
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
 
@@ -287,6 +288,16 @@ class ServedRequest:
     # computed ONCE at submit; the admission gate and engine.load walk the
     # queue with them per tick, so re-deriving would be O(queue * prompt)
     page_keys: Optional[tuple] = None
+    # fleet-level session identity (router-stamped, journaled on the accept
+    # record): lets ServingRouter.recover dedupe a session momentarily live
+    # in two replica journals mid-migration. None on engine-only callers.
+    session_id: Optional[str] = None
+    # True for already-ACCEPTED work re-entering this engine (router
+    # failover/migration continuations): such a submit bypasses the
+    # draining refusal — drain's contract is that in-flight work FINISHES,
+    # and a continuation is in-flight work whichever replica it lands on —
+    # and _begin_drain keeps it queued the way PREEMPTED continuations are
+    is_resume: bool = False
 
     @property
     def done(self) -> bool:
@@ -999,6 +1010,46 @@ class ServingEngine:
             jits.append(self._jit_reset_scales)
         return sum(f._cache_size() for f in jits)
 
+    # ----------------------------------------------------------------- params
+    def set_params(self, params) -> None:
+        """Swap the served parameters IN PLACE — the live model-version
+        rollout primitive (docs/serving.md "Fleet operations"). The compiled
+        programs take params as an ordinary argument, so a swap whose tree
+        structure, shapes, and dtypes match the current served tree costs
+        ZERO new compilations; anything else would silently recompile every
+        program on the next tick, so it is refused loudly. The same
+        weight-serving transform (``weight_dtype``) is re-applied, and the
+        dequant hook captured by the compiled closures is the module-level
+        ``dequantize_params`` (int8) or the identity — both data-independent,
+        so the existing traces serve the new tree unchanged. The caller (the
+        router's version flip) is responsible for only swapping an engine
+        that holds no in-flight sessions: a running slot's KV was built by
+        the OLD params and continuing it under new ones would break the
+        token-identity contract."""
+        served, _dq, served_bytes, fp_bytes = serve_params(params, self.weight_dtype)
+        if tree_layout_mismatch(self.params, served):
+            raise ValueError(
+                "set_params requires a tree with the structure, shapes, and "
+                "dtypes of the currently served params (anything else would "
+                "recompile every program) — deploy a matching version or "
+                "construct a fresh engine"
+            )
+        self.params = served
+        self._param_bytes, self._param_bytes_fp = served_bytes, fp_bytes
+        if self._prefix_cache is not None:
+            # the radix prefix cache deliberately outlives sessions, and its
+            # pages hold KV computed under the OLD params — serving them to
+            # a new-version prompt would decode against stale weights (the
+            # keys are token content only). A version flip starts the cache
+            # cold; its pages return to the pool.
+            self._prefix_cache.clear()
+            self.metrics.set_prefix_cache(self._prefix_cache.stats(),
+                                          self._shared_pages_in_use())
+        if self.weight_dtype is not None:
+            self.metrics.set_weight_serving(
+                self.weight_dtype, self._param_bytes, self._param_bytes_fp
+            )
+
     # -------------------------------------------------------------- capacity
     @property
     def load(self) -> int:
@@ -1121,6 +1172,8 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
         replay_ids: Optional[Sequence[int]] = None,
         priority: int = 0,
+        resume: bool = False,
+        session_id: Optional[str] = None,
         **kwargs,
     ) -> ServedRequest:
         """Queue one request; returns its handle. ``config``/kwargs follow
@@ -1135,7 +1188,13 @@ class ServingEngine:
         step after prefill — deterministic state reconstruction for router
         failover (the replayed tokens are re-emitted into ``output_ids`` and
         count toward ``max_new_tokens``); generation free-runs after the
-        stream is exhausted.
+        stream is exhausted. ``resume=True`` marks already-ACCEPTED work
+        re-entering this engine (a failover or planned-migration
+        continuation): it bypasses the draining refusal — in-flight work
+        finishes under drain, whichever replica it lands on — while every
+        other admission rule (queue bound, prompt length) applies unchanged.
+        ``session_id`` is the router's fleet-unique identity, journaled on
+        the accept record for cross-journal recovery dedup.
 
         MALFORMED requests (empty prompt, unservable config) raise ValueError
         — they are caller bugs. WELL-FORMED requests the pool cannot serve
@@ -1173,6 +1232,8 @@ class ServingEngine:
             deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
             replay_ids=np.asarray(replay_ids, np.int32).reshape(-1)
             if replay_ids is not None and len(replay_ids) else None,
+            session_id=session_id,
+            is_resume=bool(resume),
         )
         if request.deadline_s is not None:
             self._deadlines_seen = True
@@ -1200,7 +1261,12 @@ class ServingEngine:
             self._obs.async_begin(self._span_cat, request.request_id,
                                   prompt_len=int(prompt.size))
             self._obs.async_instant(self._span_cat, request.request_id, "queued")
-        if self._draining:
+        if self._draining and not request.is_resume:
+            # a RESUME (accepted-work continuation) is exempt: drain finishes
+            # in-flight work, and the router may land a failover/migration
+            # continuation on a draining sibling — refusing it here would
+            # turn a planned drain into a lost session (docs/serving.md
+            # "Fleet operations"; the PR 10 drain×recovery seam, re-audited)
             return self._reject(request, "draining")
         if prompt.size > self._window:
             return self._reject(request, "prompt_too_long")
@@ -1228,6 +1294,7 @@ class ServingEngine:
                     priority=request.priority, deadline_s=request.deadline_s,
                     replay=request.replay_ids.tolist()
                     if request.replay_ids is not None else None,
+                    session_id=request.session_id,
                 )
             except BaseException:
                 # durability cannot be promised, so the accept must not
@@ -1860,7 +1927,8 @@ class ServingEngine:
         self.metrics.set_journal(self.journal.stats())
 
     def _recover_attach(self, journal_path, fsync: str = "accept",
-                        segment_max_records: int = 4096) -> dict:
+                        segment_max_records: int = 4096,
+                        skip_session_ids=frozenset(), _state=None) -> dict:
         """Core of ``recover()``: replay a journal directory into THIS
         (freshly constructed, journal-less, empty) engine, then atomically
         swap the journal to a new generation reflecting the recovered state
@@ -1887,9 +1955,13 @@ class ServingEngine:
                 "journal=None and no submitted work)"
             )
         journal_path = os.path.abspath(os.fspath(journal_path))
-        state = read_journal(journal_path)
+        # _state lets ServingRouter.recover hand in the JournalState its
+        # dedup pre-scan already parsed — crash recovery is the latency-
+        # critical moment, so large journals are not read twice
+        state = read_journal(journal_path) if _state is None else _state
         handles: List[ServedRequest] = []
         mirror = []
+        deduped = 0
         now = time.time()
         saved_bound = self.max_queue_depth
         # accepted work is never killed by the queue bound (the router's
@@ -1898,6 +1970,16 @@ class ServingEngine:
         self.max_queue_depth = None
         try:
             for session in state.sessions:
+                if (session.session is not None
+                        and session.session in skip_session_ids):
+                    # a SUPERSEDED migration origin (ServingRouter.recover
+                    # found the same fleet session live in another replica's
+                    # journal with an equal-or-longer emitted prefix):
+                    # skipping it here — before re-submission — is what makes
+                    # exactly-once hold across the migration kill window; the
+                    # generation swap below omits it, closing the entry
+                    deduped += 1
+                    continue
                 emitted = session.emitted
                 handle = self.submit(
                     session.prompt,
@@ -1906,6 +1988,7 @@ class ServingEngine:
                     deadline_s=session.remaining_deadline(now),
                     replay_ids=emitted if emitted else None,
                     priority=session.priority,
+                    session_id=session.session,
                 )
                 if handle.status is RequestStatus.REJECTED:  # defensive: it fit once
                     raise JournalCorruptError(
@@ -1933,11 +2016,12 @@ class ServingEngine:
                     config=session.config, rng=session.rng,
                     priority=session.priority, deadline_s=handle.deadline_s,
                     accepted_ts=now, admitted=session.admitted,
-                    replay=emitted, tokens=[],
+                    replay=emitted, tokens=[], session=session.session,
                 )))
         finally:
             self.max_queue_depth = saved_bound
-        replayed = sum(len(s.emitted) for s in state.sessions)
+        replayed = sum(len(s.emitted) for s in state.sessions
+                       if not (s.session and s.session in skip_session_ids))
         if journal_enabled():
             self.journal = RequestJournal(
                 journal_path, fsync=fsync,
@@ -1956,7 +2040,11 @@ class ServingEngine:
         return {
             "sessions": len(handles),
             "replayed_tokens": replayed,
-            "in_flight": sum(1 for s in state.sessions if s.admitted),
+            "in_flight": sum(
+                1 for s in state.sessions
+                if s.admitted and not (s.session and s.session in skip_session_ids)
+            ),
+            "deduped": deduped,
             "truncated": state.truncated,
             "dropped_records": state.dropped_records,
             "records": state.records,
@@ -2318,10 +2406,14 @@ class ServingEngine:
         higher class displaced, with tokens possibly already streamed to a
         client — so they stay queued and finish through the drain loop the
         way running slots do (REJECTED is documented as "never reached a
-        slot", which would misreport them)."""
+        slot", which would misreport them). RESUME submits (router
+        failover/migration continuations that landed here) are accepted
+        mid-generation work for exactly the same reason and get exactly the
+        same treatment."""
         self._draining = True
         for request in self.scheduler.prune_queue(
             lambda r: r.status is not RequestStatus.PREEMPTED
+            and not r.is_resume
         ):
             self._reject(request, "draining")
 

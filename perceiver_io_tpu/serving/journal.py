@@ -169,6 +169,13 @@ class JournalSession:
     admitted: bool = False  # ever reached a slot (drain keeps such work)
     replay: List[int] = field(default_factory=list)  # prefix from the accept
     tokens: List[int] = field(default_factory=list)  # journaled emissions
+    # fleet-level session identity (docs/serving.md "Fleet operations"): the
+    # router stamps every accept with a fleet-unique id so a session that is
+    # momentarily live in TWO journals — the migration window between the
+    # destination's fsynced accept and the origin's close record — recovers
+    # exactly ONCE (ServingRouter.recover dedupes on it). None on engine-only
+    # journals and on pre-fleet records: dedup simply never applies there.
+    session: Optional[str] = None
 
     @property
     def emitted(self) -> List[int]:
@@ -252,6 +259,7 @@ def read_journal(path: str) -> JournalState:
                     accepted_ts=float(record.get("ts", 0.0)),
                     admitted=bool(record.get("admitted", False)),
                     replay=list(record.get("replay") or []),
+                    session=record.get("session"),
                 )
                 order.append(rid)
             elif kind == "tick":
@@ -450,12 +458,15 @@ class RequestJournal:
                       rng: Sequence[int], priority: int = 0,
                       deadline_s: Optional[float] = None,
                       replay: Optional[Sequence[int]] = None,
-                      admitted: bool = False) -> None:
+                      admitted: bool = False,
+                      session_id: Optional[str] = None) -> None:
         """The durability point of ``submit()``: once this returns, the
         request survives process death. Fsynced under the default policy —
         accepted ⇒ durable is the contract, and accepts are per-request (not
         per-token), so the fsync cost scales with admission rate, not decode
-        rate."""
+        rate. ``session_id`` is the router's fleet-unique identity for
+        cross-journal dedup (JournalSession.session); None for engine-only
+        journals."""
         if self._closed:
             raise JournalCorruptError(f"journal {self.path} is closed")
         session = JournalSession(
@@ -463,6 +474,7 @@ class RequestJournal:
             rng=[int(x) for x in rng], priority=int(priority),
             deadline_s=deadline_s, accepted_ts=time.time(),
             admitted=admitted, replay=[int(t) for t in (replay or [])],
+            session=session_id,
         )
         record = {
             "type": "accept", "rid": rid, "prompt": session.prompt,
@@ -475,6 +487,8 @@ class RequestJournal:
             record["replay"] = session.replay
         if admitted:
             record["admitted"] = True
+        if session.session is not None:
+            record["session"] = session.session
         self._append(record)
         if self.fsync in ("accept", "always"):
             self._sync()
@@ -575,6 +589,8 @@ class RequestJournal:
                     record["replay"] = emitted
                 if session.admitted:
                     record["admitted"] = True
+                if session.session is not None:
+                    record["session"] = session.session
                 records.append(record)
             for record in records:
                 line = encode_record(record) + "\n"
@@ -605,6 +621,7 @@ class RequestJournal:
                 rng=session.rng, priority=session.priority,
                 deadline_s=session.deadline_s, accepted_ts=session.accepted_ts,
                 admitted=session.admitted, replay=session.emitted, tokens=[],
+                session=session.session,
             )
             for rid, session in sessions
         }

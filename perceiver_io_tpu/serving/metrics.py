@@ -107,6 +107,21 @@ Schema history:
     untouched, else ``dtype`` ("bf16"|"int8") / ``param_bytes`` /
     ``param_bytes_fp``. The reader normalizes pre-v9 snapshots with
     ``None`` for both sections — the v2→v3 discipline throughout.
+  * ``serving-metrics/v10`` — the fleet-operations schema (docs/serving.md
+    "Fleet operations"): every snapshot carries a ``fleet_ops`` field —
+    ``None`` on plain engines (fleet lifecycle is a ROUTER behavior; also
+    the reading of every pre-v10 snapshot), else a dict of ``migrations``
+    (planned cross-replica session moves), ``recycles`` (replicas drained
+    and rebuilt by rolling restart), ``scale_ups`` / ``scale_downs``
+    (autoscaler replica-count changes), ``replicas_active`` (replicas
+    currently serving — retired ones excluded), ``restart_in_progress``,
+    and ``rollout`` — ``None`` with a single param version, else
+    ``primary_version`` / ``rollout_version`` / ``fraction`` and a
+    per-version ``versions`` table ({version: {submitted, finished,
+    tokens_generated}}). The stream gains ``migrate`` / ``recycle`` /
+    ``deploy`` / ``rollback`` / ``autoscale`` events, and ``submit`` /
+    ``finish`` events on version-pinned routers carry a ``version`` field.
+    The reader normalizes pre-v10 snapshots with ``None``.
 """
 
 from __future__ import annotations
@@ -119,7 +134,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v9"
+SCHEMA = "serving-metrics/v10"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -130,6 +145,7 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v7",
     "serving-metrics/v8",
     "serving-metrics/v9",
+    "serving-metrics/v10",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
@@ -141,6 +157,7 @@ _PRE_V6 = KNOWN_SCHEMAS[:5]
 _PRE_V7 = KNOWN_SCHEMAS[:6]
 _PRE_V8 = KNOWN_SCHEMAS[:7]
 _PRE_V9 = KNOWN_SCHEMAS[:8]
+_PRE_V10 = KNOWN_SCHEMAS[:9]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -236,6 +253,10 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # "quantization off"
                 for k in _V9_FIELDS:
                     snap.setdefault(k, None)
+            if schema in _PRE_V10:
+                # pre-v10 writers had no fleet-operations layer; None also
+                # matches a newer plain engine's truthful "no fleet"
+                snap.setdefault("fleet_ops", None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -649,6 +670,10 @@ class EngineMetrics(_JsonlMetrics):
             },
             "weight_serving": None if self.weight_serving is None
             else dict(self.weight_serving),
+            # v10: fleet lifecycle (migration / rolling restart / rollout /
+            # autoscale) is a ROUTER behavior — a plain engine truthfully
+            # has none (same reading as a pre-v10 snapshot)
+            "fleet_ops": None,
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -693,18 +718,41 @@ class RouterMetrics(_JsonlMetrics):
     failovers: int = 0  # re-dispatches of a lost replica's live requests
     shed_infeasible: int = 0  # admission-time SLO sheds (subset of rejected)
     breaker_transitions: Dict[str, int] = field(default_factory=dict)
+    # fleet-operations counters (serving-metrics/v10, docs/serving.md
+    # "Fleet operations"): planned migrations, rolling-restart recycles,
+    # autoscaler replica-count changes, and the per-version rollout table
+    migrations: int = 0  # planned cross-replica session moves
+    recycles: int = 0  # replicas drained + rebuilt (rolling restart)
+    scale_ups: int = 0
+    scale_downs: int = 0
+    replicas_active: Optional[int] = None  # None until the router gauges it
+    restart_in_progress: bool = False
+    # version -> {"submitted": n, "finished": n, "tokens_generated": n};
+    # empty until a second param version exists (single-version fleets
+    # report rollout: None — the feature-off reading)
+    versions: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    rollout_state: Optional[Dict] = None  # {primary_version, rollout_version, fraction}
     _start_time: Optional[float] = None
     _jsonl_file: Optional[object] = field(default=None, repr=False)
     _closed: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------------ events
     def record_submit(self, request_id: int, prompt_len: int,
-                      priority: int = 0) -> None:
+                      priority: int = 0, version: Optional[int] = None) -> None:
         if self._start_time is None:
             self._start_time = time.perf_counter()
         self.requests_submitted += 1
+        extra = {}
+        if version is not None:
+            self._version_row(version)["submitted"] += 1
+            extra["version"] = version
         self._emit("submit", request_id=request_id, prompt_len=prompt_len,
-                   priority=priority)
+                   priority=priority, **extra)
+
+    def _version_row(self, version: int) -> Dict[str, int]:
+        return self.versions.setdefault(
+            str(version), {"submitted": 0, "finished": 0, "tokens_generated": 0}
+        )
 
     def record_dispatch(self, request_id: int, replica: int, load: int) -> None:
         """One accepted hand-off to a replica's engine (initial dispatch or a
@@ -733,14 +781,84 @@ class RouterMetrics(_JsonlMetrics):
         self.breaker_transitions[key] = self.breaker_transitions.get(key, 0) + 1
         self._emit("breaker", replica=replica, transition=key, tick=tick)
 
+    def record_migration(self, request_id: int, src: int, dst: int,
+                         emitted_tokens: int) -> None:
+        """One PLANNED cross-replica migration (serving-metrics/v10): the
+        session left ``src`` through the engine's eviction path and landed on
+        ``dst`` as a forced replay of ``emitted_tokens`` tokens — unlike a
+        ``failover`` event, no replica was lost and the handle's failover
+        budget is untouched."""
+        self.migrations += 1
+        self._emit("migrate", request_id=request_id, src=src, dst=dst,
+                   emitted_tokens=emitted_tokens)
+
+    def record_recycle(self, replica: int, sessions_moved: int,
+                       leftover_sessions: int, tick: int) -> None:
+        """One rolling-restart recycle: the replica's sessions were migrated
+        to siblings (``sessions_moved``), its engine torn down and rebuilt
+        (journal-recovered when configured — ``leftover_sessions`` counts
+        live journal entries the rebuild re-adopted, normally 0), and the
+        replica re-admitted to the fleet."""
+        self.recycles += 1
+        self._emit("recycle", replica=replica, sessions_moved=sessions_moved,
+                   leftover_sessions=leftover_sessions, tick=tick)
+
+    def record_deploy(self, version: int, fraction: float,
+                      target_replicas: List[int]) -> None:
+        """One ``router.deploy``: a new param version entered the rollout at
+        ``fraction`` of new admissions, targeting ``target_replicas``."""
+        self.rollout_state = {"rollout_version": version,
+                              "fraction": round(float(fraction), 4)}
+        self._version_row(version)  # the table shows the version from tick 0
+        self._emit("deploy", version=version, fraction=round(float(fraction), 4),
+                   target_replicas=list(target_replicas))
+
+    def record_rollback(self, from_version: int, to_version: int) -> None:
+        """One ``router.rollback``: new admissions pin ``to_version`` again,
+        instantly; in-flight ``from_version`` sessions finish on their pin."""
+        self._emit("rollback", from_version=from_version, to_version=to_version)
+
+    def record_autoscale(self, direction: str, replica: int, active: int,
+                         load: int, tick: int) -> None:
+        """One autoscaler decision ("up" adds/revives a replica, "down"
+        retires one through the migrate-and-drain path); ``load`` is the
+        fleet-load signal at decision time, logged with the decision."""
+        if direction == "up":
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        self.replicas_active = active
+        self._emit("autoscale", direction=direction, replica=replica,
+                   active=active, load=load, tick=tick)
+
+    def set_fleet_gauges(self, replicas_active: int,
+                         restart_in_progress: bool,
+                         primary_version: Optional[int] = None) -> None:
+        """Refresh the v10 fleet gauges (the router calls this per tick).
+        ``primary_version`` only surfaces in the snapshot's rollout section
+        once a deploy has registered a second version — a single-version
+        fleet keeps the feature-off ``rollout: None`` reading."""
+        self.replicas_active = replicas_active
+        self.restart_in_progress = restart_in_progress
+        if primary_version is not None and self.rollout_state is not None:
+            self.rollout_state["primary_version"] = primary_version
+
     def record_finish(self, request_id: int, status: str, reason: Optional[str],
-                      new_tokens: int, failovers: int) -> None:
+                      new_tokens: int, failovers: int,
+                      version: Optional[int] = None) -> None:
         """Terminal router-level outcome (counter routing shared with the
         engine via ``_route_status``; rejected here covers queue/shed/drain
         refusals)."""
         self._route_status(status)
+        extra = {}
+        if version is not None:
+            row = self._version_row(version)
+            if status == "finished":
+                row["finished"] += 1
+            row["tokens_generated"] += int(new_tokens)
+            extra["version"] = version
         self._emit("finish", request_id=request_id, status=status, reason=reason,
-                   new_tokens=new_tokens, failovers=failovers)
+                   new_tokens=new_tokens, failovers=failovers, **extra)
 
     # ---------------------------------------------------------------- snapshot
     def snapshot(self, replicas: Optional[Dict[str, Dict]] = None) -> Dict:
@@ -782,6 +900,28 @@ class RouterMetrics(_JsonlMetrics):
             "chunked_prefill": None,
             "kv_quant": None,
             "weight_serving": None,
+            # v10: the fleet-operations gauges (docs/serving.md "Fleet
+            # operations") — the router owns the lifecycle, so unlike the
+            # per-engine sections above this one is real HERE. The rollout
+            # sub-section stays None until a deploy registers a second
+            # param version (the feature-off reading).
+            "fleet_ops": {
+                "migrations": self.migrations,
+                "recycles": self.recycles,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "replicas_active": (self.replicas_active
+                                    if self.replicas_active is not None
+                                    else self.num_replicas),
+                "restart_in_progress": self.restart_in_progress,
+                "rollout": None if self.rollout_state is None else {
+                    **self.rollout_state,
+                    # numeric order: string keys would misplace v10 after v1
+                    "versions": {v: dict(row)
+                                 for v, row in sorted(self.versions.items(),
+                                                      key=lambda kv: int(kv[0]))},
+                },
+            },
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),
             "wall_tokens_per_s": round(tokens / wall, 3) if wall > 0 else 0.0,

@@ -96,6 +96,21 @@ def dequantize_params(params):
     )
 
 
+def tree_layout_mismatch(a, b) -> bool:
+    """True when two param trees differ in structure, any leaf shape, or any
+    leaf dtype — the compatibility gate live param swaps run on: a
+    mismatched tree would silently recompile every compiled program, so both
+    ``ServingEngine.set_params`` (flip time) and ``ServingRouter.deploy``
+    (operator time) refuse it through this ONE definition."""
+    a_leaves, a_def = jax.tree_util.tree_flatten(a)
+    b_leaves, b_def = jax.tree_util.tree_flatten(b)
+    return a_def != b_def or any(
+        getattr(x, "shape", None) != getattr(y, "shape", None)
+        or getattr(x, "dtype", None) != getattr(y, "dtype", None)
+        for x, y in zip(a_leaves, b_leaves)
+    )
+
+
 def serve_params(
     params, weight_dtype: Optional[str]
 ) -> Tuple[Any, Callable, int, int]:

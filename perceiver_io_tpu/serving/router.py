@@ -66,23 +66,70 @@ Design:
     set ``max_queue_depth``) when unbounded waiting is unacceptable, and
     pass ``max_steps`` to the drain loops as the last-resort guard.
 
+Fleet operations (docs/serving.md "Fleet operations"): the zero-downtime
+lifecycle layer composed from the reliability primitives above —
+
+  * **Planned migration** (``migrate(request_id, dst)``): the session is
+    evicted from its LIVE origin through the engine's own release path (the
+    preemption device-side, no crash required), its emitted prefix salvaged,
+    and the continuation lands on the destination via the same forced-replay
+    submit failover uses — f64 token-identical to an unmigrated run, zero
+    new compiled programs, and the failover budget untouched. Journal
+    entries close/open exactly-once through the ``_journal_note_moved``
+    seam: the origin's entry stays LIVE until the destination's fsynced
+    accept is durable, and recovery dedupes the one double-live window
+    (between that accept and the origin's close record) by the fleet-unique
+    session id every accept now carries.
+  * **Rolling restart** (``begin_rolling_restart``/``rolling_restart``):
+    tick-driven, one replica at a time — sessions migrate to siblings (or
+    park, staying durable via their origin journal), the replica recycles
+    (engine torn down; journal-recovered on a fresh engine, which re-adopts
+    any still-parked session of its own journal), health state resets, and
+    the replica re-admits. A mid-recycle replica is treated like an OPEN
+    one everywhere (no dispatch, no ticks, no heartbeat strikes), so a
+    restart never trips its own or a sibling's breaker.
+  * **Live model-version rollout** (``deploy(params, fraction)`` /
+    ``rollback()``): the router holds N param versions; every session pins
+    ONE version for its lifetime at submit (a deterministic counter splits
+    admissions by ``fraction``), dispatch and migration only land a session
+    on a replica serving its pin, and replicas flip versions
+    (``engine.set_params`` — zero recompiles) only when empty. ``rollback``
+    is instant for new admissions; in-flight sessions finish on their pin.
+    Per-version outcomes ride the v10 ``fleet_ops.rollout`` table.
+  * **SLO-driven autoscaling** (``autoscale=dict(...)``): a deterministic
+    tick-counted controller scales the active replica count between
+    min/max from the fleet-load signal (router-parked depth + per-replica
+    queue-beyond-capacity) — scale-up revives or appends a replica,
+    scale-down retires the highest-index one through the same
+    migrate-and-drain path a recycle uses.
+
+Kill-switch: ``PERCEIVER_IO_TPU_DISABLE_FLEET_OPS=1`` makes the whole layer
+inert — ``migrate``/``deploy``/``rollback``/``begin_rolling_restart``
+refuse (returning False/None, never raising: a rollback lever must not
+crash the fleet it rolls back), the autoscaler is never constructed, and
+accept records carry no session ids — behavior identical to the pre-fleet
+router (pinned).
+
 Observability: the router resolves ONE recorder and shares it with every
 replica engine under per-replica span namespaces (``serving.r0.tick`` ...)
 and the engines' collision-safe per-engine request categories, plus its own
 ``router.*`` spans/counters — ``scripts/obs_report.py`` renders per-replica
-phase tables from the single trace. Metrics are ``serving-metrics/v9``:
+phase tables from the single trace. Metrics are ``serving-metrics/v10``:
 router snapshots embed per-replica engine snapshots, the
-failover/shed/breaker counters, and the aggregated preemption counters
+failover/shed/breaker counters, the aggregated preemption counters
 (request ``priority`` is forwarded to engines; engine-local preemption under
-page-pool pressure is docs/serving.md's "Priority classes & preemption").
+page-pool pressure is docs/serving.md's "Priority classes & preemption"),
+and the ``fleet_ops`` migration/recycle/rollout/autoscale gauges.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import os
 import random
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Sequence
@@ -105,11 +152,24 @@ from perceiver_io_tpu.serving.engine import (
     _engine_compatible,
 )
 from perceiver_io_tpu.serving.metrics import RouterMetrics
+from perceiver_io_tpu.serving.quant import tree_layout_mismatch
 
 # breaker states (str values land in metrics transition keys and trace events)
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half_open"
+
+FLEET_OPS_ENV = "PERCEIVER_IO_TPU_DISABLE_FLEET_OPS"
+
+
+def fleet_ops_enabled() -> bool:
+    """Kill-switch for the fleet-operations layer (module docstring):
+    ``PERCEIVER_IO_TPU_DISABLE_FLEET_OPS=1`` makes migration, rolling
+    restart, versioned rollout, and autoscaling inert — the lifecycle APIs
+    refuse without raising, no autoscaler runs, and journal accept records
+    carry no session ids, so behavior is identical to the pre-fleet router.
+    Checked at router construction, like the engine's feature switches."""
+    return os.environ.get(FLEET_OPS_ENV, "0").lower() in ("0", "false", "")
 
 
 @dataclass
@@ -137,6 +197,22 @@ class RoutedRequest:
     deadline_s: Optional[float] = None
     failovers: int = 0  # re-dispatches survived so far
     replica: Optional[int] = None  # current replica index (None = unplaced)
+    # param-version pin (docs/serving.md "Fleet operations"): chosen once at
+    # submit, respected by every dispatch and migration for the session's
+    # whole lifetime — a continuation never lands on a replica serving a
+    # different version than the one that decoded its prefix
+    version: int = 0
+    # fleet-unique session identity, stamped on every journal accept this
+    # session produces (origin and continuation alike): the recovery dedup
+    # key for the migration double-live window. None with fleet ops disabled.
+    session_id: Optional[str] = None
+    # True once ANY engine accepted this request: accepted work is never
+    # drain-rejected while parked and re-enters engines as resume submits
+    _accepted: bool = field(default=False, repr=False)
+    # pending close bookkeeping for _journal_note_moved: a planned migration
+    # closes its origin entry as "moved"/"migrated" instead of the failover
+    # default, so journal forensics can tell the two apart
+    _move_note: Optional[tuple] = field(default=None, repr=False)
     # longest token prefix salvaged from any lost replica; the live engine
     # handle overtakes it as its forced replay catches up
     _salvaged: List[int] = field(default_factory=list, repr=False)
@@ -243,6 +319,19 @@ class _Replica:
     # engine program count at the last healthy tick: a tick that compiled
     # something is legitimately slow and must not strike the stall detector
     _programs_seen: int = 0
+    # fleet-operations state (docs/serving.md "Fleet operations"):
+    # the param version this replica's engine currently serves, and the
+    # version it should serve (a mismatch marks a pending rollout flip —
+    # the replica takes no new work and flips once empty)
+    version: int = 0
+    target_version: int = 0
+    # mid-recycle (rolling restart / scale-down drain): treated like OPEN
+    # everywhere — no dispatch, no ticks, no heartbeat strikes — without
+    # touching the breaker ladder (a planned recycle is not a failure)
+    recycling: bool = False
+    # retired by the autoscaler: engine closed, excluded from everything;
+    # a later scale-up revives the slot with a fresh engine
+    retired: bool = False
 
 
 class ServingRouter:
@@ -285,6 +374,14 @@ class ServingRouter:
         # SLO shedding
         shed_infeasible: bool = True,
         shed_min_samples: int = 3,
+        # SLO-driven autoscaling (docs/serving.md "Fleet operations"): a
+        # dict of controller knobs — min_replicas / max_replicas /
+        # scale_up_load / scale_down_load / every_ticks / patience — or None
+        # (fixed fleet, today's behavior). Deterministic: evaluated every
+        # ``every_ticks`` router ticks on the fleet-load signal (parked
+        # depth + per-replica queue-beyond-capacity), acting only after
+        # ``patience`` consecutive over/under readings.
+        autoscale: Optional[Dict] = None,
         # internal: recover() constructs the fleet journal-less, replays each
         # replica's journal, THEN attaches — never pass this yourself
         _from_recovery: bool = False,
@@ -332,65 +429,94 @@ class ServingRouter:
         # categories are already collision-safe per engine)
         self._obs, self._owns_telemetry = resolve_recorder(telemetry)
         self._obs_on = self._obs.enabled
-        engine_telemetry = self._obs if self._obs_on else False
+        # per-engine knob bundle, kept for the fleet lifecycle: recycling a
+        # replica (rolling restart), reviving a retired one, or growing the
+        # fleet (autoscaler) rebuilds an engine with EXACTLY the geometry the
+        # fleet was constructed with — the journal records requests, not
+        # engine configuration, so the knobs must live here.
+        # Per-replica notes: each engine owns its own page pool (a failover
+        # replay allocates on the NEW replica's pool at the victim's exact
+        # page count — pinned), its own chunked-admission/prefix-cache state
+        # (a replay lands on the new replica's cache cold or warm,
+        # token-identical either way), its own served (cast/quantized) param
+        # copy, and its own priority/preemption policy; the router only
+        # forwards classes and aggregates counters (docs/serving.md).
+        self._engine_cfg = dict(
+            num_slots=num_slots,
+            cache_dtype=cache_dtype,
+            prefill_buckets=prefill_buckets,
+            max_queue_depth=max_queue_depth,
+            kv_page_size=kv_page_size,
+            num_kv_pages=num_kv_pages,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            prefix_cache=prefix_cache,
+            max_prefill_slots=max_prefill_slots,
+            kv_quant=kv_quant,
+            weight_dtype=weight_dtype,
+            priority_aging_ticks=priority_aging_ticks,
+            max_preemptions=max_preemptions,
+        )
+        self._replica_metrics_jsonl = replica_metrics_jsonl
+        # journal policy the recycle/revive rebuilds re-apply; recover()
+        # overrides them from its own arguments so a fleet recovered with
+        # fsync="always" is never silently downgraded by a later recycle
+        self._journal_fsync = "accept"
+        self._journal_segment_max = 4096
+        # fleet-operations state (module docstring; docs/serving.md "Fleet
+        # operations"). Param versions: version 0 is the constructor's tree;
+        # deploy() registers more. Every session pins one version at submit.
+        self._fleet_ops = fleet_ops_enabled()
+        self._versions: Dict[int, object] = {0: params}
+        self._next_version = 1
+        self._primary_version = 0
+        self._rollout: Optional[Dict] = None  # {"version","fraction","count","base"}
+        # fleet-unique session-id prefix: distinct per router instance, so
+        # two fleets sharing journal directories across restarts can never
+        # collide on the dedup key
+        self._fleet_id = uuid.uuid4().hex[:12]
+        # rolling restart / scale-down state: rids awaiting recycle, the rid
+        # mid-recycle, and whether that recycle rebuilds ("restart") or
+        # retires ("retire") the replica
+        self._restart_queue: List[int] = []
+        self._recycle_rid: Optional[int] = None
+        self._recycle_mode: Optional[str] = None
+        self._recycle_moved = 0
+        # autoscaler (None = fixed fleet, or fleet ops disabled)
+        self._autoscale: Optional[Dict] = None
+        if autoscale is not None and self._fleet_ops:
+            cfg = dict(autoscale)
+            self._autoscale = {
+                "min_replicas": int(cfg.pop("min_replicas", 1)),
+                "max_replicas": int(cfg.pop("max_replicas", num_replicas)),
+                "scale_up_load": int(cfg.pop("scale_up_load", 1)),
+                "scale_down_load": int(cfg.pop("scale_down_load", 0)),
+                "every_ticks": max(int(cfg.pop("every_ticks", 8)), 1),
+                "patience": max(int(cfg.pop("patience", 2)), 1),
+            }
+            if cfg:
+                raise ValueError(f"unknown autoscale knobs {sorted(cfg)}")
+            a = self._autoscale
+            if not 1 <= a["min_replicas"] <= num_replicas <= a["max_replicas"]:
+                raise ValueError(
+                    "autoscale requires 1 <= min_replicas <= num_replicas "
+                    f"<= max_replicas, got min={a['min_replicas']} "
+                    f"start={num_replicas} max={a['max_replicas']}"
+                )
+            if journal is not None and a["max_replicas"] > 1 and "{i}" not in journal:
+                raise ValueError(
+                    "journal must be a per-replica '{i}' template when the "
+                    "autoscaler can grow the fleet past one replica"
+                )
+        self._scale_up_streak = 0
+        self._scale_down_streak = 0
         self.replicas: List[_Replica] = [
-            _Replica(
-                rid=i,
-                engine=ServingEngine(
-                    model, params,
-                    num_slots=num_slots,
-                    cache_dtype=cache_dtype,
-                    prefill_buckets=prefill_buckets,
-                    max_queue_depth=max_queue_depth,
-                    # paged KV knobs (docs/serving.md, paging section): each
-                    # replica owns its own page pool — failover replays
-                    # therefore allocate on the NEW replica's pool, at the
-                    # same covering bucket and generation budget, i.e.
-                    # exactly the victim's page count (pinned, test_router)
-                    kv_page_size=kv_page_size,
-                    num_kv_pages=num_kv_pages,
-                    # chunked admission + radix prefix cache are PER-REPLICA
-                    # (docs/serving.md "Prefix cache"): each engine's trie
-                    # shares pages of its own pool, so a failover replay
-                    # lands on the new replica's cache — cold or warm, the
-                    # continuation is token-identical either way (the cache
-                    # only changes where KV comes from, never its values);
-                    # recovered sessions likewise re-resolve their replica's
-                    # fresh cache cold
-                    prefill_chunk_tokens=prefill_chunk_tokens,
-                    prefix_cache=prefix_cache,
-                    max_prefill_slots=max_prefill_slots,
-                    # quantized serving is per-replica like the pool it
-                    # shrinks (docs/serving.md "Quantized KV pages & weight
-                    # serving"): every replica serves the same byte layout,
-                    # so a failover replay re-quantizes the victim's prompt
-                    # + emitted tokens on the NEW replica's pool through the
-                    # same deterministic write paths — the continuation is
-                    # token-identical to an uncontended quantized run
-                    # (pinned, tests/test_router.py). weight_dtype likewise:
-                    # each replica holds its own served (cast/quantized)
-                    # copy of the params.
-                    kv_quant=kv_quant,
-                    weight_dtype=weight_dtype,
-                    # priority/preemption policy is per-engine (each replica
-                    # preempts over its own slots and pool); the router only
-                    # forwards classes and reads the aggregated counters
-                    priority_aging_ticks=priority_aging_ticks,
-                    max_preemptions=max_preemptions,
-                    # per-replica engine event stream: a "{i}" placeholder in
-                    # the template keeps the streams separate per replica
-                    metrics_jsonl=replica_metrics_jsonl.format(i=i)
-                    if replica_metrics_jsonl else None,
-                    # per-replica crash-durable journal (same "{i}" template
-                    # discipline as the metrics streams); _from_recovery
-                    # leaves engines journal-less so recover() can replay the
-                    # existing directories before attaching them
-                    journal=journal.format(i=i)
-                    if journal and not _from_recovery else None,
-                    telemetry=engine_telemetry,
-                    obs_ns=f"serving.r{i}",
-                ),
-            )
+            _Replica(rid=i, engine=self._make_engine(
+                i,
+                # _from_recovery leaves engines journal-less so recover()
+                # can replay the existing directories before attaching them
+                journal_path=journal.format(i=i)
+                if journal and not _from_recovery else None,
+            ))
             for i in range(num_replicas)
         ]
         self.metrics = RouterMetrics(num_replicas=num_replicas, jsonl_path=metrics_jsonl)
@@ -412,6 +538,28 @@ class ServingRouter:
             self._preempt_handler, self._preempt_previous = (
                 install_preemption_handler(_request_preempt)
             )
+
+    def _make_engine(self, rid: int, journal_path: Optional[str] = None,
+                     version: Optional[int] = None) -> ServingEngine:
+        """One replica engine at the fleet's configured geometry, serving
+        ``version``'s params (the primary version by default) — the single
+        construction point initial build, recycle, revive, and scale-up all
+        share, so a rebuilt replica can never drift from the fleet's knobs."""
+        version = self._primary_version if version is None else version
+        return ServingEngine(
+            self.model, self._versions[version],
+            metrics_jsonl=self._replica_metrics_jsonl.format(i=rid)
+            if self._replica_metrics_jsonl else None,
+            journal=journal_path,
+            telemetry=self._obs if self._obs_on else False,
+            obs_ns=f"serving.r{rid}",
+            **self._engine_cfg,
+        )
+
+    def _active_replicas(self) -> List[_Replica]:
+        """Every non-retired replica (recycling ones included — they are
+        still part of the fleet, just momentarily out of service)."""
+        return [r for r in self.replicas if not r.retired]
 
     # ---------------------------------------------------------------- recovery
     @classmethod
@@ -465,13 +613,47 @@ class ServingRouter:
                 )
         router = cls(model, params, num_replicas=num_replicas,
                      journal=journal, _from_recovery=True, **router_kwargs)
+        router._journal_fsync = fsync
+        router._journal_segment_max = segment_max_records
+        # cross-journal session dedup (docs/serving.md "Fleet operations"):
+        # a planned migration has ONE window — after the destination's
+        # fsynced accept, before the origin's close record — where the same
+        # fleet session is live in two replica journals. Pre-read every
+        # journal and, per session id, keep only the copy with the LONGEST
+        # emitted prefix (the destination's accept folds the origin's whole
+        # prefix into its replay, so it is always >=; ties keep the
+        # lowest-index replica — deterministic). The losers are skipped
+        # BEFORE re-submission and omitted from the swapped generation, so
+        # a re-crash re-dedupes identically and the caller sees the session
+        # exactly once. Sessions without ids (engine-only journals,
+        # pre-fleet records) are never deduped.
+        from perceiver_io_tpu.serving.journal import read_journal as _read
+
+        best: Dict[str, tuple] = {}  # session id -> (replica rid, emitted len)
+        per_journal_ids: Dict[int, set] = {}
+        states: Dict[int, object] = {}
+        for r in router.replicas:
+            ids = set()
+            state = _read(journal.format(i=r.rid))
+            states[r.rid] = state
+            for s in state.sessions:
+                if s.session is None:
+                    continue
+                ids.add(s.session)
+                cur = best.get(s.session)
+                if cur is None or len(s.emitted) > cur[1]:
+                    best[s.session] = (r.rid, len(s.emitted))
+            per_journal_ids[r.rid] = ids
         now = time.perf_counter()
         handles: List[RoutedRequest] = []
         per_replica: Dict[str, Dict] = {}
         for r in router.replicas:
+            skip = frozenset(sid for sid in per_journal_ids[r.rid]
+                             if best[sid][0] != r.rid)
             info = r.engine._recover_attach(
                 journal.format(i=r.rid), fsync=fsync,
                 segment_max_records=segment_max_records,
+                skip_session_ids=skip, _state=states[r.rid],
             )
             for handle in info.pop("handles"):
                 routed = RoutedRequest(
@@ -482,8 +664,15 @@ class ServingRouter:
                     priority=handle.priority,
                     submitted_at=now,
                     deadline_s=handle.deadline_s,
+                    # version pins do NOT survive process death: the journal
+                    # records requests, not weights, so every recovered
+                    # session re-pins the params handed to recover() — the
+                    # same contract as engine geometry kwargs
+                    version=router._primary_version,
+                    session_id=handle.session_id,
                 )
                 routed._engine_handle = handle
+                routed._accepted = True
                 routed.replica = r.rid
                 r.assigned[handle.request_id] = routed
                 if routed.deadline_s is not None:
@@ -505,6 +694,7 @@ class ServingRouter:
             "sessions": len(handles),
             "replayed_tokens": sum(i["replayed_tokens"]
                                    for i in per_replica.values()),
+            "deduped": sum(i["deduped"] for i in per_replica.values()),
             "replicas": per_replica,
             "handles": handles,
         }
@@ -548,11 +738,21 @@ class ServingRouter:
             priority=int(priority),
             submitted_at=time.perf_counter(),
             deadline_s=deadline_s if deadline_s is not None else self.default_deadline_s,
+            # version pin (docs/serving.md "Fleet operations"): chosen HERE,
+            # once, by the deterministic rollout split — every later
+            # dispatch, failover, and migration respects it
+            version=self._pick_version(),
         )
+        if self._fleet_ops:
+            routed.session_id = f"{self._fleet_id}:{routed.request_id}"
         if routed.deadline_s is not None:
             self._deadlines_seen = True
+        # version rides the event stream only when fleet ops are live: the
+        # kill-switch contract is a byte-identical pre-fleet stream
         self.metrics.record_submit(routed.request_id, int(prompt.size),
-                                   priority=routed.priority)
+                                   priority=routed.priority,
+                                   version=routed.version
+                                   if self._fleet_ops else None)
         if self._obs_on:
             self._obs.async_begin("router.request", routed.request_id,
                                   prompt_len=int(prompt.size))
@@ -575,11 +775,40 @@ class ServingRouter:
         return routed
 
     # ---------------------------------------------------------------- dispatch
-    def _serving_replicas(self) -> List[_Replica]:
-        """Replicas eligible for NEW work: breaker CLOSED, least-loaded first
-        (ties on the lowest index — deterministic placement)."""
-        eligible = [r for r in self.replicas if r.breaker == BREAKER_CLOSED]
-        return sorted(eligible, key=lambda r: (r.engine.load, r.rid))
+    def _pick_version(self) -> int:
+        """The version pin for one new admission: the primary version, or —
+        during a rollout — the rollout version for a deterministic
+        ``fraction`` of admissions (admission k takes the new version iff
+        ``floor((k+1)f) > floor(kf)``: a pure function of the submit count,
+        no clocks, no randomness — the faults.py discipline)."""
+        if self._rollout is None:
+            return self._primary_version
+        f = self._rollout["fraction"]
+        k = self._rollout["count"]
+        self._rollout["count"] = k + 1
+        if math.floor((k + 1) * f) > math.floor(k * f):
+            return self._rollout["version"]
+        return self._primary_version
+
+    def _serving_replicas(self, version: Optional[int] = None,
+                          include_flipping: bool = False) -> List[_Replica]:
+        """Replicas eligible for NEW work: breaker CLOSED, not mid-recycle or
+        retired, serving ``version`` when one is given (dispatch and
+        migration respect the session's pin) — least-loaded first, ties on
+        the lowest index (deterministic placement). Replicas awaiting a
+        version flip are excluded for fresh submits; with
+        ``include_flipping`` (accepted-work continuations) they are eligible
+        LAST — they still run the session's pinned params until they flip,
+        and serving continuity outranks flip speed — so a continuation is
+        never stranded while any engine of its version is alive."""
+        eligible = [
+            r for r in self.replicas
+            if r.breaker == BREAKER_CLOSED and not r.recycling and not r.retired
+            and (version is None or r.version == version)
+            and (include_flipping or r.version == r.target_version)
+        ]
+        return sorted(eligible, key=lambda r: (r.version != r.target_version,
+                                               r.engine.load, r.rid))
 
     def _remaining_deadline(self, routed: RoutedRequest, now: float) -> Optional[float]:
         """Deadline budget LEFT for an engine hand-off: the engine enforces
@@ -590,7 +819,8 @@ class ServingRouter:
             return None
         return max(routed.deadline_at - now, 0.0)
 
-    def _dispatch(self, routed: RoutedRequest, requeue: bool = False) -> bool:
+    def _dispatch(self, routed: RoutedRequest, requeue: bool = False,
+                  exclude_rid: Optional[int] = None) -> bool:
         """Place one request (fresh, or a failover continuation) on the
         least-loaded healthy replica. Returns True when the request reached a
         terminal or assigned state, False when it was parked in the router
@@ -620,9 +850,12 @@ class ServingRouter:
             return True
         now = time.perf_counter()
         saw_closed = False
-        for r in self._serving_replicas():
+        for r in self._serving_replicas(routed.version,
+                                        include_flipping=requeue):
             if r.breaker != BREAKER_CLOSED:
                 continue  # opened mid-scan by a dispatch-failure cascade
+            if r.rid == exclude_rid:
+                continue  # the replica being drained must not re-admit its own drain
             saw_closed = True
             load_at_decision = r.engine.load  # submit() bumps it
             try:
@@ -631,6 +864,12 @@ class ServingRouter:
                     deadline_s=self._remaining_deadline(routed, now),
                     replay_ids=emitted if emitted else None,
                     priority=routed.priority,
+                    # accepted work re-enters as a RESUME: a draining engine
+                    # takes it (drain finishes in-flight work) while fresh
+                    # submits keep today's refusal; the session id rides the
+                    # accept record for cross-journal recovery dedup
+                    resume=routed._accepted,
+                    session_id=routed.session_id,
                 )
             except BaseException as exc:  # noqa: BLE001
                 # a dispatch-path failure — a journal append dying on real
@@ -655,14 +894,19 @@ class ServingRouter:
                 return True
             routed._engine_handle = handle
             routed.replica = r.rid
+            routed._accepted = True
             # the salvage buffer is NOT cleared: output_ids reports
             # max(salvage, engine stream), so the view stays monotonic while
             # the engine re-emits the replayed prefix
             r.assigned[handle.request_id] = routed
             # the new replica's journal now holds the continuation (fresh
-            # accept, replay prefix included): close the failover origin's
-            # live entry so a later fleet recovery replays the session ONCE
-            self._journal_note_moved(routed)
+            # accept, replay prefix included): close the origin's live entry
+            # so a later fleet recovery replays the session ONCE — as
+            # "moved"/"migrated" when a planned migration queued the note,
+            # the failover default otherwise
+            note = routed._move_note or ("failed", "replica_failover")
+            routed._move_note = None
+            self._journal_note_moved(routed, status=note[0], reason=note[1])
             self.metrics.record_dispatch(routed.request_id, r.rid,
                                          load=load_at_decision)
             if self._obs_on:
@@ -695,7 +939,10 @@ class ServingRouter:
         return False
 
     def _dispatch_pending(self) -> None:
-        while self._pending and any(r.breaker == BREAKER_CLOSED for r in self.replicas):
+        while self._pending and any(
+            r.breaker == BREAKER_CLOSED and not r.recycling and not r.retired
+            for r in self.replicas
+        ):
             routed = self._pending.popleft()
             if routed.done:  # expired while parked
                 continue
@@ -742,6 +989,663 @@ class ServingRouter:
         except Exception:  # noqa: BLE001 — durability bookkeeping, not control flow
             pass
 
+    # --------------------------------------------------------------- fleet ops
+    def _find_live(self, request_id: int) -> Optional[RoutedRequest]:
+        """The live routed handle for a router-level request id (assigned to
+        any replica, or parked), or None for unknown/terminal ids."""
+        for r in self.replicas:
+            for routed in r.assigned.values():
+                if routed.request_id == request_id and not routed.done:
+                    return routed
+        for routed in self._pending:
+            if routed.request_id == request_id and not routed.done:
+                return routed
+        return None
+
+    def _detach_session(self, r: _Replica, engine_rid: int,
+                        routed: RoutedRequest, reason: str = "migrated") -> None:
+        """Lift one live session off a LIVE replica (planned migration /
+        recycle drain — the engine is healthy, unlike failover's lost one):
+        the slot and pages release through the engine's own eviction path,
+        the emitted prefix is salvaged as the continuation's replay stream,
+        and the origin journal entry STAYS LIVE (``journal_terminal=False``)
+        as the continuation's durability anchor until it lands elsewhere —
+        the ``_journal_note_moved`` seam, reused exactly."""
+        handle = routed._engine_handle
+        r.assigned.pop(engine_rid, None)
+        r.engine.evict_request(engine_rid, reason,
+                               status=RequestStatus.REJECTED,
+                               journal_terminal=False)
+        # the evicted engine handle is router bookkeeping, not a terminal
+        # outcome: drop it before a harvest could misread it as REJECTED
+        r.engine.finished = [h for h in r.engine.finished if h is not handle]
+        # keep the LONGEST known token prefix: an engine handle mid-replay
+        # holds the full stream in replay_ids while output_ids still trails
+        # (the _preempt discipline), and the existing salvage may already be
+        # the longest — all are prefixes of the same true stream
+        streams = [routed._salvaged]
+        if handle is not None:
+            streams.append(list(handle.output_ids))
+            if handle.replay_ids is not None:
+                streams.append([int(t) for t in handle.replay_ids])
+        routed._salvaged = max(streams, key=len)
+        if (r.engine.journal is not None
+                and r.engine.journal.tracks(engine_rid)):
+            routed._journal_origin = (r.rid, engine_rid)
+        routed._engine_handle = None
+        routed.replica = None
+
+    def _hand_off_to(self, routed: RoutedRequest, r: _Replica) -> bool:
+        """Land one continuation on a SPECIFIC replica (the migration
+        targetting primitive; ``_dispatch`` keeps the least-loaded scan for
+        everything else). True when the session landed; False leaves the
+        session exactly as it was — parked/detached, durable via its origin
+        anchor — for the caller to re-home."""
+        emitted = routed._salvaged
+        if emitted and len(emitted) >= routed.config.max_new_tokens:
+            self._resolve(routed, RequestStatus.FINISHED, "length")
+            return True
+        load_at_decision = r.engine.load
+        try:
+            handle = r.engine.submit(
+                routed.prompt_ids, config=routed.config, rng=routed.rng,
+                deadline_s=self._remaining_deadline(routed, time.perf_counter()),
+                replay_ids=emitted if emitted else None,
+                priority=routed.priority,
+                resume=routed._accepted,
+                session_id=routed.session_id,
+            )
+        except BaseException as exc:  # noqa: BLE001 — replica fault containment
+            self._on_tick_failure(r, exc)
+            return False
+        if handle.status is RequestStatus.REJECTED:
+            return False  # backpressure (or refusal) at the target: not landed
+        routed._engine_handle = handle
+        routed.replica = r.rid
+        routed._accepted = True
+        r.assigned[handle.request_id] = routed
+        # the destination's fsynced accept is durable HERE while the origin
+        # entry is still live — the one double-live instant; the chaos
+        # harness turns this fault point into a real child SIGKILL and pins
+        # that recovery dedup resolves it to exactly one session
+        faults.fire_migrate_kill()
+        note = routed._move_note or ("failed", "replica_failover")
+        routed._move_note = None
+        self._journal_note_moved(routed, status=note[0], reason=note[1])
+        self.metrics.record_dispatch(routed.request_id, r.rid,
+                                     load=load_at_decision)
+        if self._obs_on:
+            self._obs.async_instant("router.request", routed.request_id,
+                                    "dispatch", replica=r.rid,
+                                    failover_n=routed.failovers)
+        return True
+
+    def migrate(self, request_id: int, dst: int) -> bool:
+        """PLANNED cross-replica migration (module docstring): preempt the
+        session on its origin through the live engine's own eviction path —
+        no crash required — and land the continuation on replica ``dst`` via
+        the forced-replay submit, f64 token-identical to an unmigrated run
+        with zero new compiled programs and the failover budget untouched.
+        Journal entries close/open exactly-once through the
+        ``_journal_note_moved`` seam. Malformed calls (unknown/terminal
+        request, bad or non-serving destination, a destination whose version
+        differs from the session's pin) raise ValueError; a destination that
+        refuses for capacity returns False with the session safely re-homed
+        on any pin-matching replica (or parked, still durable). Returns True
+        once the session runs on ``dst``. Inert (False) under the
+        ``PERCEIVER_IO_TPU_DISABLE_FLEET_OPS`` kill-switch."""
+        if not self._fleet_ops:
+            return False
+        if not 0 <= dst < len(self.replicas):
+            raise ValueError(f"unknown replica index {dst}")
+        routed = self._find_live(request_id)
+        if routed is None:
+            raise ValueError(f"unknown or terminal request {request_id}")
+        r_dst = self.replicas[dst]
+        if (r_dst.retired or r_dst.recycling
+                or r_dst.breaker != BREAKER_CLOSED):
+            raise ValueError(f"replica {dst} is not serving (breaker "
+                             f"{r_dst.breaker}, recycling={r_dst.recycling}, "
+                             f"retired={r_dst.retired})")
+        if r_dst.version != routed.version or r_dst.version != r_dst.target_version:
+            raise ValueError(
+                f"migration respects the version pin: request "
+                f"{request_id} is pinned to v{routed.version}, replica {dst} "
+                f"serves v{r_dst.version} (target v{r_dst.target_version})"
+            )
+        if routed.replica == dst:
+            return True  # already there: a no-op, not an error
+        src = routed.replica
+        handle = routed._engine_handle
+        if src is not None and handle is not None:
+            if handle.done:
+                return False  # terminal at the engine; harvest resolves it
+            self._detach_session(self.replicas[src], handle.request_id, routed)
+        elif routed in self._pending:
+            # a parked continuation migrates by simply landing on the target
+            self._pending.remove(routed)
+        routed._move_note = ("moved", "migrated")
+        if self._hand_off_to(routed, r_dst):
+            if routed.replica == dst:
+                self.metrics.record_migration(
+                    routed.request_id, src if src is not None else -1, dst,
+                    emitted_tokens=len(routed._salvaged),
+                )
+                if self._obs_on:
+                    self._obs.counter_inc("router.migrations")
+                    self._obs.async_instant("router.request",
+                                            routed.request_id, "migrate",
+                                            src=src, dst=dst)
+            # else: the hand-off resolved the session terminally (a salvaged
+            # prefix already at max_new_tokens) — complete, but no move
+            # happened, so the migration counters must not claim one
+            return True
+        # the destination would not take it (queue at bound, mid-scan
+        # breaker trip): the session is accepted work — re-home it on any
+        # pin-matching replica, else park at the FRONT (it is older than
+        # anything a fresh submit parked behind it)
+        routed._move_note = None
+        if routed.done:
+            return False  # the refusal resolved it (defensive)
+        if not self._dispatch(routed, requeue=True):
+            self._pending.appendleft(routed)
+        return False
+
+    def _drain_replica(self, r: _Replica, reason: str = "recycle") -> int:
+        """Move every live session off a replica (recycle/retire/flip
+        drains): detach through the live engine, then re-home each
+        continuation on a pin-matching sibling — or park it (front of the
+        router queue, admission order preserved), where it stays durable via
+        its origin journal anchor and, for a recycle, is re-adopted by the
+        rebuilt replica's own journal recovery. Returns the count that moved
+        or parked."""
+        moved = 0
+        parked: List[RoutedRequest] = []
+        for engine_rid, routed in sorted(r.assigned.items()):
+            handle = routed._engine_handle
+            if handle is not None and handle.done:
+                # terminal at the engine but unharvested: the outcome stands
+                r.assigned.pop(engine_rid, None)
+                self._resolve(routed, handle.status, handle.finish_reason)
+                continue
+            self._detach_session(r, engine_rid, routed, reason=reason)
+            routed._move_note = ("moved", reason)
+            if not self._dispatch(routed, requeue=True, exclude_rid=r.rid):
+                parked.append(routed)
+            moved += 1
+        if parked:
+            # park as one block at the FRONT, admission order preserved
+            # among themselves (extendleft reverses — the _failover_replica
+            # discipline; per-item appendleft would invert the group)
+            self._pending.extendleft(reversed(parked))
+        return moved
+
+    # ------------------------------------------------------- rolling restart
+    @property
+    def restart_in_progress(self) -> bool:
+        return bool(self._restart_queue) or self._recycle_rid is not None
+
+    def begin_rolling_restart(self) -> bool:
+        """Start a tick-driven rolling restart: every active replica is
+        recycled in index order, one at a time — sessions migrate to
+        siblings (or park, durably anchored), the engine is torn down and
+        journal-recovered fresh, health state resets, and the replica
+        re-admits before the next one starts. ``step()`` advances it;
+        ``rolling_restart()`` is the synchronous convenience. Returns False
+        (refusing, never raising) under the kill-switch or while draining;
+        True if a restart is now (or already was) in progress."""
+        if not self._fleet_ops or self._draining:
+            return False
+        if self.restart_in_progress:
+            return True
+        self._restart_queue = [r.rid for r in self.replicas if not r.retired]
+        if self._obs_on:
+            self._obs.counter_inc("router.rolling_restarts")
+        return True
+
+    def rolling_restart(self, max_steps: Optional[int] = None) -> bool:
+        """Synchronous rolling restart: begin, then step the fleet until
+        every replica has been recycled — requests submitted meanwhile are
+        served throughout (the bounded-blip contract the serve_bench
+        ``--rolling-restart`` arm measures). Returns False when refused
+        (kill-switch, draining)."""
+        if not self.begin_rolling_restart():
+            return False
+        steps = 0
+        while self.restart_in_progress:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"rolling restart incomplete after {max_steps} steps"
+                )
+        return True
+
+    def _start_recycle(self, r: _Replica, mode: str) -> None:
+        """Take a replica out of service for recycling ("restart") or
+        retirement ("retire"): the flag makes it read like an OPEN breaker
+        everywhere — no dispatch, no ticks, no heartbeat strikes (a planned
+        recycle is not a failure and must not climb the backoff ladder or
+        cascade strikes onto siblings) — then its sessions drain out. The
+        rebuild/close completes on the NEXT tick (_finish_recycle), so a
+        mid-recycle window is observable and chaos-killable."""
+        r.recycling = True
+        self._recycle_rid = r.rid
+        self._recycle_mode = mode
+        self._recycle_moved = self._drain_replica(r, reason=mode)
+
+    def _build_fresh(self, rid: int, version: int):
+        """A fresh engine for a recycled/revived replica slot: when the
+        fleet journals and this slot's directory already exists, the rebuild
+        goes THROUGH journal recovery (an empty-live-session recovery in the
+        normal case — the swap starts a new generation; any leftover live
+        session is re-adopted by the caller), otherwise a plain construction
+        with the journal attached directly."""
+        journal_dir = (self._journal_template.format(i=rid)
+                       if self._journal_template else None)
+        if journal_dir is not None and os.path.isdir(journal_dir):
+            fresh = self._make_engine(rid, journal_path=None, version=version)
+            info = fresh._recover_attach(
+                journal_dir, fsync=self._journal_fsync,
+                segment_max_records=self._journal_segment_max,
+            )
+            return fresh, info
+        return self._make_engine(rid, journal_path=journal_dir,
+                                 version=version), None
+
+    def _finish_recycle(self, r: _Replica) -> None:
+        """Complete the recycle begun last tick: tear the old engine down
+        (journal flushed+closed), rebuild through journal recovery (restart)
+        or retire the slot (scale-down), re-adopt any parked session the old
+        journal still anchored, and reset the replica's health record — a
+        recycled replica earns a clean slate, INCLUDING the compile-tick
+        baseline (a fresh engine's first ticks compile; a stale program
+        count could collide with the fresh one and let those ticks strike
+        the stall detector)."""
+        mode, self._recycle_mode = self._recycle_mode, None
+        self._recycle_rid = None
+        r.engine.discard_pending_harvest()
+        r.engine.close()
+        if mode == "retire":
+            r.retired = True
+            r.recycling = False
+            r.orphaned.clear()
+            return
+        # VERSION-PRESERVING rebuild: any session the journal recovery
+        # re-adopts below is pinned to the version this replica was serving
+        # (it ran here) — rebuilding at target_version would decode its
+        # remaining tokens under different weights. A pending flip
+        # (target != version) is the flip path's job: it fires as usual
+        # once the rebuilt replica is empty.
+        fresh, info = self._build_fresh(r.rid, r.version)
+        r.engine = fresh
+        leftovers = info["sessions"] if info else 0
+        if info:
+            self._adopt_recovered(r, info)
+        r.recycling = False
+        r.orphaned.clear()
+        r.breaker = BREAKER_CLOSED
+        r.consecutive_failures = 0
+        r.consecutive_slow = 0
+        r.nan_failures = 0
+        r.open_count = 0
+        r.cooldown_ticks = 0
+        r._programs_seen = 0
+        r.last_tick = self._tick
+        r.last_error = None
+        self.metrics.record_recycle(r.rid, sessions_moved=self._recycle_moved,
+                                    leftover_sessions=leftovers,
+                                    tick=self._tick)
+        if self._obs_on:
+            self._obs.counter_inc("router.recycles")
+            self._obs.instant("router.recycle", replica=r.rid,
+                              sessions_moved=self._recycle_moved,
+                              leftovers=leftovers)
+
+    def _adopt_recovered(self, r: _Replica, info: Dict) -> None:
+        """Wire a rebuilt replica's journal-recovered sessions back into the
+        router's books. A recovered session whose fleet id matches a PARKED
+        continuation is the SAME session (its drain-out couldn't land on a
+        sibling): the parked handle adopts the fresh engine handle — no
+        duplicate RoutedRequest, and the origin anchor clears because the
+        swapped generation now holds the session under the new engine rid.
+        Anything else (a session the drain somehow left behind) enters the
+        books as a fresh submit+dispatch pair, the recover() discipline."""
+        now = time.perf_counter()
+        parked = {p.session_id: p for p in self._pending
+                  if p.session_id is not None and not p.done}
+        for handle in info.pop("handles"):
+            routed = parked.get(handle.session_id)
+            if routed is not None and routed.version != r.version:
+                # pin mismatch (a revive at a different version than the
+                # session decoded under): the session stays PARKED — lift it
+                # back off this engine without journaling a terminal, and
+                # re-anchor it to the NEW generation's accept (the swap
+                # already made that its durable copy); it lands when a
+                # pin-matching replica frees
+                r.engine.evict_request(handle.request_id, "version_mismatch",
+                                       status=RequestStatus.REJECTED,
+                                       journal_terminal=False)
+                r.engine.finished = [h for h in r.engine.finished
+                                     if h is not handle]
+                routed._journal_origin = (r.rid, handle.request_id)
+                continue
+            if routed is not None:
+                self._pending.remove(routed)
+                routed._journal_origin = None
+                routed._move_note = None
+            else:
+                routed = RoutedRequest(
+                    request_id=next(self._ids),
+                    prompt_ids=handle.prompt_ids,
+                    config=handle.config,
+                    rng=handle.rng,
+                    priority=handle.priority,
+                    submitted_at=now,
+                    deadline_s=handle.deadline_s,
+                    # the rebuild is version-preserving (_finish_recycle):
+                    # a recovered session decoded here, so its pin is the
+                    # version this replica serves
+                    version=r.version,
+                    session_id=handle.session_id,
+                )
+                if routed.deadline_s is not None:
+                    self._deadlines_seen = True
+                self.metrics.record_submit(routed.request_id,
+                                           int(handle.prompt_ids.size),
+                                           priority=routed.priority,
+                                           version=routed.version)
+                if self._obs_on:
+                    self._obs.async_begin("router.request", routed.request_id,
+                                          prompt_len=int(handle.prompt_ids.size))
+            routed._engine_handle = handle
+            routed._accepted = True
+            handle.is_resume = True  # accepted work: a later drain keeps it
+            routed.replica = r.rid
+            r.assigned[handle.request_id] = routed
+            self.metrics.record_dispatch(routed.request_id, r.rid,
+                                         load=r.engine.load)
+
+    # ---------------------------------------------------------------- rollout
+    def deploy(self, params, fraction: float = 1.0) -> Optional[int]:
+        """Register a new param version and roll it out LIVE: a
+        deterministic ``fraction`` of new admissions pins the new version
+        (``_pick_version``), and the last ``ceil(fraction * active)``
+        replicas are targeted to flip to it — each flips (``set_params``,
+        zero recompiles) only once empty of its current sessions, which
+        either migrate to pin-matching siblings or finish in place. Returns
+        the version id (None under the kill-switch / while draining).
+        ``fraction=1.0`` is a full rollout; in-flight sessions still finish
+        on the version that decoded their prefix — the lifetime pin."""
+        if not self._fleet_ops or self._draining:
+            return None
+        if not 0.0 <= float(fraction) <= 1.0:
+            raise ValueError(f"fraction must lie in [0, 1], got {fraction}")
+        # validate the tree NOW, where the operator can react: a mismatch
+        # discovered at flip time would raise out of step() on every tick
+        # (engine.set_params refuses through the same shared gate, because
+        # shape/dtype/structure drift would silently recompile every program)
+        if tree_layout_mismatch(self._versions[self._primary_version], params):
+            raise ValueError(
+                "deploy requires a params tree with the structure, shapes, "
+                "and dtypes of the serving versions (anything else would "
+                "recompile every program at flip time)"
+            )
+        version = self._next_version
+        self._next_version += 1
+        self._versions[version] = params
+        base = self._primary_version
+        self._rollout = {"version": version, "fraction": float(fraction),
+                         "count": 0, "base": base}
+        active = self._active_replicas()
+        k = math.ceil(float(fraction) * len(active)) if fraction > 0 else 0
+        targets = [r.rid for r in active[len(active) - k:]] if k else []
+        for r in active:
+            r.target_version = version if r.rid in targets else base
+        self.metrics.record_deploy(version, float(fraction), targets)
+        self.metrics.set_fleet_gauges(len(active), self.restart_in_progress,
+                                      self._primary_version)
+        if self._obs_on:
+            self._obs.counter_inc("router.deploys")
+            self._obs.instant("router.deploy", version=version,
+                              fraction=float(fraction))
+        return version
+
+    def rollback(self) -> bool:
+        """Instant rollback of the active rollout: new admissions pin the
+        pre-deploy version again IMMEDIATELY; replicas re-target it and
+        flip back as they empty; in-flight rollout-version sessions finish
+        on their pin (never re-decoded under different weights). False when
+        no rollout is active or the kill-switch is set."""
+        if not self._fleet_ops or self._rollout is None:
+            return False
+        version = self._rollout["version"]
+        base = self._rollout["base"]
+        self._rollout = None
+        self._primary_version = base
+        for r in self.replicas:
+            if not r.retired:
+                r.target_version = base
+        self._prune_versions()
+        self.metrics.record_rollback(version, base)
+        if self._obs_on:
+            self._obs.counter_inc("router.rollbacks")
+            self._obs.instant("router.rollback", from_version=version,
+                              to_version=base)
+        return True
+
+    def _prune_versions(self) -> None:
+        """Drop param trees nothing references anymore — not the primary or
+        active rollout, no replica's current/target version, no live
+        session's pin. Without this a long-lived fleet doing periodic
+        deploys retains one full model copy per deploy forever."""
+        keep = {self._primary_version}
+        if self._rollout is not None:
+            keep.add(self._rollout["version"])
+            keep.add(self._rollout["base"])
+        for r in self.replicas:
+            keep.add(r.version)
+            keep.add(r.target_version)
+        for r in self.replicas:
+            keep.update(routed.version for routed in r.assigned.values())
+        keep.update(p.version for p in self._pending)
+        for v in [v for v in self._versions if v not in keep]:
+            del self._versions[v]
+
+    def _advance_rollout_flips(self) -> None:
+        """Flip every target-mismatched replica that can flip: an empty one
+        swaps params now (its in-cache state belongs to no session); a
+        non-empty one drains to pin-matching siblings when any exist, else
+        its sessions finish in place and the flip waits. A flip is deferred
+        while parked work pinned to the replica's CURRENT version has no
+        other replica still running that version — flipping would strand it
+        (continuations may land on a flip-pending replica, new work may
+        not)."""
+        for r in self.replicas:
+            if r.retired or r.recycling or r.version == r.target_version:
+                continue
+            if r.assigned:
+                if self._serving_replicas(version=r.version):
+                    self._drain_replica(r, reason="version_flip")
+                continue  # re-checked next tick (sessions may finish/park)
+            if r.engine.scheduler.has_work:
+                continue  # engine-queued work (resumes) still pending
+            others_running = any(
+                o is not r and not o.retired and not o.recycling
+                and o.version == r.version
+                for o in self.replicas
+            )
+            if (not others_running
+                    and any(p.version == r.version for p in self._pending)):
+                continue  # last engine of a version with parked work: wait
+            r.engine.set_params(self._versions[r.target_version])
+            r.version = r.target_version
+            if self._obs_on:
+                self._obs.instant("router.version_flip", replica=r.rid,
+                                  version=r.version)
+        # FULL-rollout promotion: once a fraction-1.0 deploy has flipped
+        # every active replica (and no parked work still pins the old
+        # version), the rollout version BECOMES the primary — later
+        # scale-ups/revives build it, and a fresh deploy rolls out against
+        # it. Partial rollouts stay split by design until rollback or a
+        # full deploy; rollback() after promotion is a no-op (there is no
+        # rollout left to roll back — deploy the old params instead).
+        if self._rollout is not None and self._rollout["fraction"] >= 1.0:
+            v = self._rollout["version"]
+            active = self._active_replicas()
+            if (active
+                    and all(r.version == v and r.target_version == v
+                            for r in active)
+                    and not any(p.version != v for p in self._pending)):
+                self._primary_version = v
+                self._rollout = None
+                self._prune_versions()
+                self.metrics.set_fleet_gauges(len(active),
+                                              self.restart_in_progress, v)
+                if self._obs_on:
+                    self._obs.instant("router.version_promoted", version=v)
+
+    # -------------------------------------------------------------- autoscale
+    def _fleet_load(self) -> int:
+        """The autoscaler's signal: router-parked depth plus every serving
+        replica's queue-beyond-capacity — deterministic given the
+        submit/tick history (no clocks), like every scaling decision."""
+        load = len(self._pending)
+        for r in self.replicas:
+            if r.retired or r.recycling or r.breaker == BREAKER_OPEN:
+                continue
+            load += max(r.engine.load, 0)
+        return load
+
+    def _autoscale_eval(self) -> None:
+        a = self._autoscale
+        if self._tick % a["every_ticks"] != 0:
+            return
+        load = self._fleet_load()
+        active = self._active_replicas()
+        if load >= a["scale_up_load"]:
+            self._scale_up_streak += 1
+            self._scale_down_streak = 0
+        elif load <= a["scale_down_load"]:
+            self._scale_down_streak += 1
+            self._scale_up_streak = 0
+        else:
+            self._scale_up_streak = 0
+            self._scale_down_streak = 0
+        if (self._scale_up_streak >= a["patience"]
+                and len(active) < a["max_replicas"]):
+            self._scale_up_streak = 0
+            self._scale_up(load)
+        elif (self._scale_down_streak >= a["patience"]
+                and len(active) > a["min_replicas"]
+                and self._recycle_rid is None
+                and not self._restart_queue):
+            self._scale_down_streak = 0
+            self._scale_down(load)
+
+    def _scale_up(self, load: int) -> None:
+        """Add capacity: revive the lowest-index retired slot (its journal
+        directory, if any, recovers — normally empty of live sessions), or
+        append a brand-new replica at the next index."""
+        retired = [r for r in self.replicas if r.retired]
+        if retired:
+            r = min(retired, key=lambda x: x.rid)
+            fresh, info = self._build_fresh(r.rid, self._primary_version)
+            r.engine = fresh
+            r.retired = False
+            r.recycling = False
+            r.breaker = BREAKER_CLOSED
+            r.version = r.target_version = self._primary_version
+            r.consecutive_failures = r.consecutive_slow = 0
+            r.nan_failures = r.open_count = r.cooldown_ticks = 0
+            r._programs_seen = 0
+            r.last_tick = self._tick
+            r.last_error = None
+            if info:
+                self._adopt_recovered(r, info)
+            rid = r.rid
+        else:
+            rid = len(self.replicas)
+            fresh, info = self._build_fresh(rid, self._primary_version)
+            r = _Replica(rid=rid, engine=fresh,
+                         version=self._primary_version,
+                         target_version=self._primary_version)
+            r.last_tick = self._tick
+            self.replicas.append(r)
+            if info:
+                self._adopt_recovered(r, info)
+        self.metrics.record_autoscale("up", rid,
+                                      active=len(self._active_replicas()),
+                                      load=load, tick=self._tick)
+        if self._obs_on:
+            self._obs.counter_inc("router.scale_ups")
+
+    def _scale_down(self, load: int) -> None:
+        """Shed capacity through the SAME migrate-and-drain path a recycle
+        uses: the highest-index active replica whose retirement strands
+        nothing (its version must survive on a sibling while any session
+        still pins it) drains its sessions to siblings and is closed next
+        tick."""
+        candidates = sorted(
+            (r for r in self.replicas if not r.retired and not r.recycling),
+            key=lambda x: -x.rid,
+        )
+        for r in candidates:
+            others = any(
+                o is not r and not o.retired and o.version == r.version
+                for o in self.replicas
+            )
+            pinned = bool(r.assigned) or any(
+                p.version == r.version for p in self._pending
+            )
+            if pinned and not others:
+                continue  # retiring the last engine of a pinned version strands it
+            if self._rollout is not None:
+                # an ACTIVE rollout keeps pinning a fraction of new
+                # admissions to its version: retiring the last replica
+                # targeting it would park that fraction forever (scale-up
+                # builds the primary) — a silent admission black-hole only
+                # rollback() could clear
+                v = self._rollout["version"]
+                if r.target_version == v and not any(
+                    o is not r and not o.retired and o.target_version == v
+                    for o in self.replicas
+                ):
+                    continue
+            self.metrics.record_autoscale(
+                "down", r.rid, active=len(self._active_replicas()) - 1,
+                load=load, tick=self._tick,
+            )
+            if self._obs_on:
+                self._obs.counter_inc("router.scale_downs")
+            self._start_recycle(r, mode="retire")
+            return
+
+    def _advance_fleet_ops(self) -> None:
+        """One tick of fleet-lifecycle progress, run inside ``step()``:
+        complete the recycle begun last tick, then — unless draining —
+        advance rollout flips, start the next rolling-restart recycle
+        (after the previous one's parked work had a tick to land), and
+        evaluate the autoscaler. All decisions are tick-counted and
+        deterministic."""
+        if not self._fleet_ops:
+            return
+        if self._recycle_rid is not None:
+            self._finish_recycle(self.replicas[self._recycle_rid])
+        if self._draining:
+            # a draining fleet finishes the in-flight recycle (parked work
+            # may need that replica back) but starts nothing new
+            self._restart_queue = []
+            return
+        self._advance_rollout_flips()
+        if self._recycle_rid is None and self._restart_queue:
+            rid = self._restart_queue.pop(0)
+            r = self.replicas[rid]
+            if not r.retired:
+                self._start_recycle(r, mode="restart")
+        if self._autoscale is not None:
+            self._autoscale_eval()
+
     # ----------------------------------------------------------------- breaker
     def _transition(self, r: _Replica, new: str) -> None:
         old, r.breaker = r.breaker, new
@@ -772,6 +1676,8 @@ class ServingRouter:
 
     def _promote_breakers(self) -> None:
         for r in self.replicas:
+            if r.recycling or r.retired:
+                continue  # out of service by PLAN, not by the breaker
             if (
                 r.breaker == BREAKER_OPEN
                 and self._tick - r.opened_at_tick >= r.cooldown_ticks
@@ -945,7 +1851,9 @@ class ServingRouter:
         # a parked continuation resolving terminally (TTL expiry, drain,
         # max_failovers) must close its failover origin's journal entry with
         # the real outcome, or a later fleet recovery would resurrect a
-        # request the caller already saw go terminal
+        # request the caller already saw go terminal (the real outcome also
+        # supersedes any queued migration note)
+        routed._move_note = None
         self._journal_note_moved(routed, status=status.value,
                                  reason=reason or "resolved")
         routed._terminal_status = status
@@ -955,6 +1863,7 @@ class ServingRouter:
         self.metrics.record_finish(
             routed.request_id, status.value, reason,
             new_tokens=len(routed.output_ids), failovers=routed.failovers,
+            version=routed.version if self._fleet_ops else None,
         )
         if self._obs_on:
             if status is RequestStatus.REJECTED:
@@ -967,15 +1876,21 @@ class ServingRouter:
     # -------------------------------------------------------------------- step
     @property
     def has_work(self) -> bool:
-        """True while any non-terminal request can still make progress:
-        parked requests, live hand-offs, or engine-side work on replicas the
-        router still ticks. A permanently-OPEN replica's stale slots do NOT
-        count — their requests already moved on."""
+        """True while any non-terminal request can still make progress —
+        parked requests, live hand-offs, engine-side work on replicas the
+        router still ticks — or while a rolling restart is mid-flight (a
+        ``run_until_drained`` that exited with a replica half-recycled would
+        strand it out of service until some later step; a restart always
+        completes in bounded ticks, so this can never spin). A
+        permanently-OPEN replica's stale slots do NOT count — their requests
+        already moved on."""
         return (
             bool(self._pending)
+            or self.restart_in_progress
             or any(r.assigned for r in self.replicas)
             or any(
-                r.breaker != BREAKER_OPEN and r.engine.scheduler.has_work
+                r.breaker != BREAKER_OPEN and not r.recycling and not r.retired
+                and r.engine.scheduler.has_work
                 for r in self.replicas
             )
         )
@@ -995,10 +1910,20 @@ class ServingRouter:
             if self._deadlines_seen:
                 self._expire_pending(now)
             self._promote_breakers()
+            # fleet lifecycle (module docstring): finish last tick's recycle,
+            # advance rollout flips, start the next restart recycle, evaluate
+            # the autoscaler — BEFORE pending dispatch, so work parked by a
+            # drain (and capacity returned by a rebuild) lands this tick
+            self._advance_fleet_ops()
             self._dispatch_pending()
             # CLOSED replicas serve; HALF_OPEN replicas always get their probe
-            # tick (even idle — an un-probed idle replica would never close)
-            ticking = [r for r in self.replicas if r.breaker != BREAKER_OPEN]
+            # tick (even idle — an un-probed idle replica would never close).
+            # Mid-recycle and retired replicas are never ticked: a planned
+            # recycle reads like an OPEN breaker everywhere, so it can never
+            # strike its own or a sibling's detector (docs/serving.md)
+            ticking = [r for r in self.replicas
+                       if r.breaker != BREAKER_OPEN
+                       and not r.recycling and not r.retired]
             dispatched: List[_Replica] = []
             for r in ticking:
                 try:
@@ -1042,13 +1967,28 @@ class ServingRouter:
 
     def _begin_drain(self) -> None:
         """Close admission fleet-wide: reject the router-parked backlog and
-        every replica's queued backlog; active slots keep decoding."""
+        every replica's queued backlog; active slots keep decoding. Parked
+        CONTINUATIONS are not backlog — a failover/migration continuation is
+        accepted mid-generation work, with tokens possibly already streamed
+        to a client and a live journal entry anchoring it — so like the
+        engine's PREEMPTED continuations they stay parked and FINISH through
+        the drain loop (landing on draining engines as resumes); only
+        never-accepted fresh submits reject (the drain×parked-work seam, the
+        PR 10 drain×recovery audit re-run at the router layer). A rolling
+        restart in progress is cancelled (its queued recycles never start;
+        the one in flight completes so parked work can re-land)."""
         self._draining = True
+        kept: Deque[RoutedRequest] = deque()
         while self._pending:
             routed = self._pending.popleft()
-            self._resolve(routed, RequestStatus.REJECTED, "draining")
+            if routed._accepted:
+                kept.append(routed)
+            else:
+                self._resolve(routed, RequestStatus.REJECTED, "draining")
+        self._pending = kept
+        self._restart_queue = []
         for r in self.replicas:
-            if r.breaker == BREAKER_OPEN:
+            if r.breaker == BREAKER_OPEN or r.recycling or r.retired:
                 continue  # nothing to reject; its requests already moved on
             r.engine._begin_drain()
 
@@ -1073,7 +2013,7 @@ class ServingRouter:
         decode steps) — a cold fleet must never shed."""
         best = None
         for r in self.replicas:
-            if r.breaker != BREAKER_CLOSED:
+            if r.breaker != BREAKER_CLOSED or r.recycling or r.retired:
                 continue
             est = r.engine.metrics.latency_estimates()
             if est is None or est["decode_steps"] < self.shed_min_samples:
@@ -1093,19 +2033,33 @@ class ServingRouter:
         return self._obs
 
     def snapshot(self) -> Dict:
-        """serving-metrics/v9 router snapshot with per-replica sections."""
+        """serving-metrics/v10 router snapshot with per-replica sections."""
         return self.metrics.snapshot(self._replica_snapshots())
 
     def write_snapshot(self) -> Dict:
         return self.metrics.write_snapshot(self._replica_snapshots())
 
     def _replica_snapshots(self) -> Dict[str, Dict]:
+        self.metrics.set_fleet_gauges(
+            len([r for r in self._active_replicas() if not r.recycling]),
+            self.restart_in_progress,
+            self._primary_version,
+        )
         out = {}
         for r in self.replicas:
             snap = r.engine.metrics.snapshot()
             snap["breaker"] = r.breaker
             snap["last_tick"] = r.last_tick
             snap["nan_failures"] = r.nan_failures
+            if r.recycling:
+                snap["recycling"] = True
+            if r.retired:
+                snap["retired"] = True
+            if self._next_version > 1:
+                # version markers only once a rollout exists — single-version
+                # snapshots stay byte-compatible with the pre-fleet shape
+                snap["version"] = r.version
+                snap["target_version"] = r.target_version
             if r.last_error:
                 snap["last_error"] = r.last_error
             out[f"r{r.rid}"] = snap
@@ -1121,7 +2075,7 @@ class ServingRouter:
         unexpected: List = []
         backend = 0
         for r in self.replicas:
-            if r.engine.watchdog is None:
+            if r.retired or r.engine.watchdog is None:
                 continue
             s = r.engine.watchdog.summary()
             per_fn.update(s["per_function"])

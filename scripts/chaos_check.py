@@ -72,6 +72,24 @@ exercised on every change, not just when production finds them:
                            dense run (scripts/journal_crash_harness.py
                            --chunked)
 
+  * ``rolling_restart_under_load`` (kill-free) a journaled 2-replica fleet
+                           takes a rolling restart while requests keep
+                           arriving: every replica recycles (sessions
+                           migrated to siblings, engines journal-recovered
+                           fresh), zero breaker transitions, and every
+                           accepted session finishes exactly once, f64
+                           token-identical to an undisturbed run —
+                           repeat-run deterministic
+  * ``migrate_crash_midflight`` a REAL child router process SIGKILLs
+                           ITSELF inside a planned migration's double-live
+                           window (destination accept fsynced, origin close
+                           record unwritten — ``router.migrate.kill``);
+                           fleet recovery dedupes the twice-live session by
+                           its fleet id and every accepted session finishes
+                           exactly once, token-identically, decode still one
+                           program (scripts/journal_crash_harness.py
+                           migrate-proof)
+
 Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
 
   * ``router_crash_failover`` a replica crashed mid-decode loses nothing:
@@ -926,6 +944,109 @@ def check_chunked_prefill_recovery() -> dict:
     }
 
 
+def check_rolling_restart_under_load() -> dict:
+    """Zero-downtime fleet ops (docs/serving.md "Fleet operations"): a
+    journaled 2-replica fleet takes a rolling restart UNDER LOAD — requests
+    keep arriving while each replica drains (sessions migrate to its
+    sibling or park durably), recycles (fresh engine, journal-recovered),
+    and re-admits. Every accepted session finishes exactly once, f64
+    token-identical to an undisturbed run; no breaker ever trips (a planned
+    recycle is not a failure); repeat runs are identical."""
+    from perceiver_io_tpu.serving import ServingEngine, ServingRouter
+
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8], [9, 10], [11, 12, 13], [14, 15]]
+        engine = ServingEngine(model, params, num_slots=len(prompts))
+        refs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        engine.run_until_drained(max_steps=300)
+        expected = [h.result().tolist() for h in refs]
+
+        def run():
+            d = tempfile.mkdtemp(prefix="chaos-rolling-")
+            try:
+                router = ServingRouter(model, params, num_replicas=2,
+                                       num_slots=2,
+                                       journal=os.path.join(d, "r{i}"))
+                handles = [router.submit(p, max_new_tokens=8)
+                           for p in prompts[:3]]
+                for _ in range(2):
+                    router.step()
+                assert router.begin_rolling_restart()
+                i, steps = 3, 0
+                while router.restart_in_progress and steps < 200:
+                    if i < len(prompts):  # sustained load during the restart
+                        handles.append(router.submit(prompts[i],
+                                                     max_new_tokens=8))
+                        i += 1
+                    router.step()
+                    steps += 1
+                while i < len(prompts):
+                    handles.append(router.submit(prompts[i], max_new_tokens=8))
+                    i += 1
+                router.run_until_drained(max_steps=500)
+                snap = router.snapshot()
+                router.close()
+                return {
+                    "statuses": [h.status.value for h in handles],
+                    "tokens": [h.result().tolist() for h in handles],
+                    "recycles": snap["fleet_ops"]["recycles"],
+                    "breaker_transitions": snap["breaker_transitions"],
+                    "submitted": snap["requests_submitted"],
+                    "finished": snap["requests_finished"],
+                }
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        r1, r2 = run(), run()
+    return {
+        "ok": (
+            r1["statuses"] == ["finished"] * len(prompts)
+            and r1["tokens"] == expected
+            and r1["recycles"] == 2
+            and r1["breaker_transitions"] == {}
+            and r1["submitted"] == r1["finished"] == len(prompts)
+            and r1 == r2
+        ),
+        "statuses": r1["statuses"],
+        "outputs_identical": r1["tokens"] == expected,
+        "recycles": r1["recycles"],
+        "breaker_transitions": r1["breaker_transitions"],
+        "sessions_lost": r1["submitted"] - r1["finished"],
+        "deterministic_repeat": r1 == r2,
+    }
+
+
+def check_migrate_crash_midflight() -> dict:
+    """A REAL child router process dies (self-SIGKILL, no flush) inside a
+    planned migration's double-live window — after the destination's
+    fsynced accept, before the origin journal's close record. Fleet
+    recovery dedupes the twice-live session by its fleet-unique id: every
+    accepted session finishes exactly ONCE, f64 token-identical (greedy +
+    sampled), zero extra compiled programs. Run twice into fresh
+    directories against one deterministic reference."""
+    harness = _load_crash_harness()
+    runs, shared = [], None
+    with _x64():
+        for _ in range(2):
+            d = tempfile.mkdtemp(prefix="chaos-migrate-crash-")
+            try:
+                result = harness.run_migrate_crash(d, shared=shared)
+                shared = result.pop("_shared")
+                runs.append(result)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+    return {
+        "ok": all(r["ok"] for r in runs),
+        "runs": [
+            {k: r[k] for k in ("double_live", "sessions_recovered", "deduped",
+                               "outputs_identical", "all_finished",
+                               "decode_compilations")}
+            for r in runs
+        ],
+    }
+
+
 def check_router_crash_failover() -> dict:
     """A replica crashed mid-decode loses nothing: the victim finishes
     token-identical (f64) to the fault-free run after failover, the survivor
@@ -1095,6 +1216,8 @@ CHECKS = {
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,
     "router_drain": check_router_drain,
+    "rolling_restart_under_load": check_rolling_restart_under_load,
+    "migrate_crash_midflight": check_migrate_crash_midflight,
 }
 
 

@@ -122,6 +122,39 @@ def _write_progress(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
+def serve_migrate(journal_template: str, progress: str) -> None:
+    """Child mode for the ``migrate_crash_midflight`` chaos scenario: a
+    2-replica journaled ROUTER decodes the fixed workload, then attempts a
+    planned migration of the first request with the ``router.migrate.kill``
+    fault armed — the fault fires in the double-live window (destination
+    accept fsynced, origin close record not yet written) and the child
+    SIGKILLs ITSELF there: a real process death, no flush, no destructor, no
+    atexit. The parent recovers the fleet from the two journals and pins
+    that the momentarily twice-live session executes exactly ONCE,
+    token-identically."""
+    model, params = build_model()
+    from perceiver_io_tpu.reliability import FAULTS
+    from perceiver_io_tpu.reliability.faults import KilledMidWrite
+    from perceiver_io_tpu.serving import ServingRouter
+
+    router = ServingRouter(model, params, num_replicas=2, num_slots=NUM_SLOTS,
+                           journal=journal_template)
+    handles = _submit_workload(router)
+    for _ in range(2):
+        router.step()  # a couple of tokens decoded: the migration is mid-request
+    victim = handles[0]
+    _write_progress(progress, {"accepted": len(handles), "ticks": 2,
+                               "migrating": True})
+    FAULTS.arm("router.migrate.kill", times=1)
+    try:
+        router.migrate(victim.request_id, 1 - victim.replica)
+    except KilledMidWrite:
+        # the genuine article: SIGKILL leaves the journals exactly as the
+        # fault found them — destination accept durable, origin still live
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise RuntimeError("router.migrate.kill never fired")  # parent treats as failure
+
+
 def serve(journal_dir: str, progress: str, chunked: bool = False) -> None:
     """Child mode: journaled serving loop, slow-ticked, killed externally.
     ``chunked`` runs the paged + chunked-prefill engine on the
@@ -261,12 +294,84 @@ def run_crash_restart(workdir: str, kill_after_ticks: int = 2,
     return result
 
 
+def run_migrate_crash(workdir: str, shared=None, timeout_s: float = 120.0) -> dict:
+    """The ``migrate_crash_midflight`` proof, parent side: reference run →
+    child router self-SIGKILLed inside the migration double-live window →
+    fleet recovery → exactly-once + identity + compile checks. The dedup
+    precondition (the same session live in BOTH journals at death) is
+    asserted from the raw journals before recovery touches them."""
+    model, params, expected = shared if shared is not None else (None,) * 3
+    if model is None:
+        model, params = build_model()
+    if expected is None:
+        expected = reference_outputs(model, params)
+    template = os.path.join(workdir, "journal", "r{i}")
+    progress = os.path.join(workdir, "progress.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "serve-migrate",
+         "--journal-dir", template, "--progress", progress],
+        env=env, cwd=_REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    try:
+        child.wait(timeout=timeout_s)  # the child kills ITSELF at the fault
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+    stderr = child.stderr.read().decode(errors="replace")
+    child.stderr.close()
+    if child.returncode != -signal.SIGKILL:
+        raise RuntimeError(
+            f"migrate child exited rc={child.returncode}, expected SIGKILL "
+            f"(-9): {stderr[-2000:]}"
+        )
+
+    from perceiver_io_tpu.serving import ServingRouter, read_journal
+
+    # the double-live precondition: the migrated session must exist in BOTH
+    # journals at death, so total live records exceed the workload
+    live = sum(len(read_journal(template.format(i=i)).sessions)
+               for i in range(2))
+    router, info = ServingRouter.recover(model, params, template,
+                                         num_replicas=2, num_slots=NUM_SLOTS)
+    router.run_until_drained(max_steps=400)
+    handles = info["handles"]
+    by_prompt = {tuple(h.prompt_ids.tolist()): h.result().tolist()
+                 for h in handles}
+    outputs = [by_prompt.get(tuple(prompt)) for prompt, _m, _s, _r in WORKLOAD]
+    decode_compiles = max(r.engine.decode_compilations for r in router.replicas)
+    result = {
+        "live_sessions_at_death": live,
+        "double_live": live == len(WORKLOAD) + 1,
+        "sessions_recovered": info["sessions"],
+        "deduped": info["deduped"],
+        "all_finished": all(h.ok for h in handles),
+        "outputs_identical": outputs == expected,
+        "decode_compilations": decode_compiles,
+        "ok": (
+            live == len(WORKLOAD) + 1
+            and info["sessions"] == len(WORKLOAD)
+            and info["deduped"] == 1
+            and all(h.ok for h in handles)
+            and outputs == expected
+            and decode_compiles <= 1
+        ),
+        "_shared": (model, params, expected),
+    }
+    router.close()
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("mode", nargs="?", default="proof",
-                    choices=("proof", "serve"),
+                    choices=("proof", "serve", "serve-migrate", "migrate-proof"),
                     help="proof = full parent-side kill/restart run; "
-                         "serve = internal child mode")
+                         "migrate-proof = parent-side migration-window kill; "
+                         "serve / serve-migrate = internal child modes")
     ap.add_argument("--journal-dir", default=None)
     ap.add_argument("--progress", default=None)
     ap.add_argument("--workdir", default=None,
@@ -282,12 +387,20 @@ def main(argv=None):
             ap.error("serve mode needs --journal-dir and --progress")
         serve(args.journal_dir, args.progress, chunked=args.chunked)
         return None
+    if args.mode == "serve-migrate":
+        if not (args.journal_dir and args.progress):
+            ap.error("serve-migrate mode needs --journal-dir and --progress")
+        serve_migrate(args.journal_dir, args.progress)
+        return None
 
     import tempfile
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="journal-crash-")
-    result = run_crash_restart(workdir, kill_after_ticks=args.kill_after_ticks,
-                               chunked=args.chunked)
+    if args.mode == "migrate-proof":
+        result = run_migrate_crash(workdir)
+    else:
+        result = run_crash_restart(workdir, kill_after_ticks=args.kill_after_ticks,
+                                   chunked=args.chunked)
     result.pop("_shared", None)  # live jax objects, not part of the artifact
     print(json.dumps(result, indent=1))
     if not result["ok"]:

@@ -256,6 +256,34 @@ def report_serving_metrics(path: str) -> Dict:
         # untouched params, router snapshot, or pre-v9 stream)
         out["kv_quant"] = snap.get("kv_quant")
         out["weight_serving"] = snap.get("weight_serving")
+        # serving-metrics/v10 fleet-operations gauges (None: plain engine
+        # or pre-v10 stream; real on router snapshots)
+        out["fleet_ops"] = snap.get("fleet_ops")
+        migrations = [e for e in loaded["events"] if e.get("event") == "migrate"]
+        if migrations:
+            out["migrate_events"] = {
+                "count": len(migrations),
+                "emitted_tokens": sum(e.get("emitted_tokens", 0)
+                                      for e in migrations),
+            }
+        recycles = [e for e in loaded["events"] if e.get("event") == "recycle"]
+        if recycles:
+            out["recycle_events"] = {
+                "count": len(recycles),
+                "sessions_moved": sum(e.get("sessions_moved", 0)
+                                      for e in recycles),
+                "leftover_sessions": sum(e.get("leftover_sessions", 0)
+                                         for e in recycles),
+            }
+        autoscales = [e for e in loaded["events"]
+                      if e.get("event") == "autoscale"]
+        if autoscales:
+            out["autoscale_events"] = {
+                "count": len(autoscales),
+                "ups": sum(1 for e in autoscales if e.get("direction") == "up"),
+                "downs": sum(1 for e in autoscales
+                             if e.get("direction") == "down"),
+            }
         prefix_hits = [e for e in loaded["events"] if e.get("event") == "prefix_hit"]
         if prefix_hits:
             out["prefix_hit_events"] = {
@@ -377,6 +405,33 @@ def main(argv=None) -> Dict:
             ratio = f"{served / fp_b:.2f}x fp" if fp_b else "n/a"
             print("weight serving: "
                   f"dtype={ws.get('dtype')}, params {served} bytes ({ratio})")
+        # v10 fleet-operations rendering (suppressed where the reader
+        # normalized to None: plain engine or pre-v10 stream) — the
+        # migration/recycle/rollout/autoscale story an operator audits
+        # after a deploy or a capacity change
+        fo = section.get("fleet_ops")
+        if fo:
+            print("fleet ops: "
+                  f"{fo.get('migrations')} migrations, "
+                  f"{fo.get('recycles')} recycles, "
+                  f"scale +{fo.get('scale_ups')}/-{fo.get('scale_downs')}, "
+                  f"{fo.get('replicas_active')} replicas active"
+                  + (", restart in progress"
+                     if fo.get("restart_in_progress") else ""))
+            rollout = fo.get("rollout")
+            if rollout:
+                print("  rollout: "
+                      f"primary v{rollout.get('primary_version')}, "
+                      f"v{rollout.get('rollout_version')} at "
+                      f"{rollout.get('fraction')}")
+                for v, row in sorted((rollout.get("versions") or {}).items(),
+                                     key=lambda kv: int(kv[0])):
+                    print(f"    v{v}: {row.get('submitted')} submitted, "
+                          f"{row.get('finished')} finished, "
+                          f"{row.get('tokens_generated')} tokens")
+            for key in ("migrate_events", "recycle_events", "autoscale_events"):
+                if section.get(key):
+                    print(f"  {key}:", json.dumps(section[key]))
         # v7 journal health + recovery rendering (suppressed on journal-less
         # engines and pre-v7 streams, where the reader normalized to None)
         jstats = section.get("journal")

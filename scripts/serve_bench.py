@@ -74,6 +74,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -301,6 +302,141 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
             multi["admission_prompt_tokens_per_s"]
             / single["admission_prompt_tokens_per_s"], 3,
         ) if single["admission_prompt_tokens_per_s"] > 0 else 0.0,
+    }
+
+
+def run_rolling_restart(model, config, params, num_replicas: int,
+                        num_slots: int, seed: int, max_new: int = 24,
+                        rollout_fraction: float = 0.5) -> dict:
+    """Fleet-operations arm (ROADMAP item 4 / docs/serving.md "Fleet
+    operations"): the cost of a zero-downtime rolling restart, measured as
+    the RUNNING sessions' inter-token latency blip. A sustained streamed
+    workload runs twice through an ``num_replicas``-replica router —
+    steady-state, then with a rolling restart triggered mid-stream — and
+    each pass records every running session's tick-to-tick inter-token gaps,
+    tagged by whether the restart was in progress. Acceptance: sessions
+    lost = 0 in both passes (every submit FINISHED — a restart drops
+    nothing), and the during-restart p95 inter-token gap is a bounded blip,
+    reported as ``blip_p95_ratio`` against the steady-state p95. A third
+    pass deploys a second param version at ``rollout_fraction`` mid-stream
+    and reports the v10 per-version throughput table (the rollout arm)."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    requests = synth_workload(config, 6 * num_slots, seed)
+    for r in requests:
+        r["max_new_tokens"] = max_new  # uniform: gaps compare apples to apples
+
+    def streamed_pass(router, restart_after: Optional[int] = None,
+                      deploy_after: Optional[int] = None, deploy_params=None):
+        """Submit one request per tick until the workload drains; returns
+        (gaps_steady, gaps_during_restart, handles, steps)."""
+        handles, last_len, last_t = [], {}, {}
+        gaps_steady, gaps_restart = [], []
+        i = step = 0
+        more = True
+        while more or i < len(requests):
+            if i < len(requests):
+                h = router.submit(requests[i]["prompt"],
+                                  max_new_tokens=requests[i]["max_new_tokens"],
+                                  rng=jax.random.PRNGKey(i))
+                handles.append(h)
+                i += 1
+            if restart_after is not None and step == restart_after:
+                assert router.begin_rolling_restart()
+            if deploy_after is not None and step == deploy_after:
+                router.deploy(deploy_params, fraction=rollout_fraction)
+            more = router.step()
+            now = time.perf_counter()
+            in_restart = router.restart_in_progress
+            for h in handles:
+                n = len(h.output_ids)
+                if n > last_len.get(h.request_id, 0):
+                    prev = last_t.get(h.request_id)
+                    if prev is not None:
+                        (gaps_restart if in_restart else gaps_steady).append(
+                            now - prev)
+                    last_t[h.request_id] = now
+                    last_len[h.request_id] = n
+            step += 1
+            if step > 20_000:
+                raise RuntimeError("fleet-ops arm failed to drain")
+        return gaps_steady, gaps_restart, handles, step
+
+    def gap_stats(gaps):
+        if not gaps:
+            return {"n": 0, "p50_ms": None, "p95_ms": None}
+        s = sorted(gaps)
+        return {"n": len(s), "p50_ms": round(_pct(s, 0.50) * 1e3, 3),
+                "p95_ms": round(_pct(s, 0.95) * 1e3, 3)}
+
+    # warmup compiles every covering bucket on a throwaway fleet
+    warm = ServingRouter(model, params, num_replicas=num_replicas,
+                         num_slots=num_slots, telemetry=False)
+    streamed_pass(warm)
+    warm.close()
+
+    # steady-state pass
+    router = ServingRouter(model, params, num_replicas=num_replicas,
+                           num_slots=num_slots, telemetry=False)
+    steady, _, handles_a, steps_a = streamed_pass(router)
+    snap_a = router.snapshot()
+    router.close()
+    # restart pass: the rolling restart begins once the fleet is saturated
+    router = ServingRouter(model, params, num_replicas=num_replicas,
+                           num_slots=num_slots, telemetry=False)
+    base, during, handles_b, steps_b = streamed_pass(
+        router, restart_after=2 * num_slots)
+    snap_b = router.snapshot()
+    recycles = snap_b["fleet_ops"]["recycles"]
+    router.close()
+    # rollout pass: deploy a second version mid-stream, report per-version
+    # throughput (params_v2 = a fresh copy of the same tree — the arm
+    # measures accounting and steady service, not model quality)
+    params_v2 = jax.tree_util.tree_map(lambda x: x, params)
+    router = ServingRouter(model, params, num_replicas=num_replicas,
+                           num_slots=num_slots, telemetry=False)
+    t0 = time.perf_counter()
+    _, _, handles_c, _ = streamed_pass(router, deploy_after=2 * num_slots,
+                                       deploy_params=params_v2)
+    rollout_wall = time.perf_counter() - t0
+    snap_c = router.snapshot()
+    rollout = snap_c["fleet_ops"]["rollout"]
+    router.close()
+
+    steady_stats = gap_stats(steady)
+    during_stats = gap_stats(during)
+    lost = {
+        "steady": sum(1 for h in handles_a if not h.ok),
+        "restart": sum(1 for h in handles_b if not h.ok),
+        "rollout": sum(1 for h in handles_c if not h.ok),
+    }
+    blip = (round(during_stats["p95_ms"] / steady_stats["p95_ms"], 3)
+            if during_stats["p95_ms"] and steady_stats["p95_ms"] else None)
+    per_version = {
+        v: {**row, "tokens_per_s": round(row["tokens_generated"] / rollout_wall, 2)
+            if rollout_wall > 0 else 0.0}
+        for v, row in (rollout or {}).get("versions", {}).items()
+    }
+    return {
+        "replicas": num_replicas,
+        "slots_per_replica": num_slots,
+        "requests": len(requests),
+        "max_new_tokens": max_new,
+        "steady_inter_token": steady_stats,
+        "restart_baseline_inter_token": gap_stats(base),
+        "during_restart_inter_token": during_stats,
+        "blip_p95_ratio": blip,
+        "recycles": recycles,
+        "sessions_lost": lost,
+        "sessions_lost_total": sum(lost.values()),
+        "steady_steps": steps_a,
+        "restart_steps": steps_b,
+        "rollout": {
+            "fraction": rollout_fraction,
+            "per_version": per_version,
+            "migrations": snap_c["fleet_ops"]["migrations"],
+        },
+        "breaker_transitions_during_restart": snap_b["breaker_transitions"],
     }
 
 
@@ -1440,6 +1576,17 @@ def main(argv=None) -> dict:
                          "median-of --replica-repeats); the block lands in the "
                          "--profile-out artifact (BENCH_serving.json)")
     ap.add_argument("--replica-repeats", type=int, default=7)
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="run the fleet-operations arm (docs/serving.md "
+                         "'Fleet operations'): a streamed workload through a "
+                         "--restart-replicas-replica router, steady-state vs "
+                         "with a rolling restart triggered mid-stream — "
+                         "running-session inter-token p50/p95 and the "
+                         "during-restart p95 blip ratio, sessions lost "
+                         "(acceptance: 0), plus a live-rollout pass with "
+                         "per-version throughput; the block lands in the "
+                         "--profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--restart-replicas", type=int, default=2)
     args = ap.parse_args(argv)
     if args.replicas == 1:
         ap.error("--replicas needs N >= 2 (the arm compares N replicas against 1)")
@@ -1504,6 +1651,13 @@ def main(argv=None) -> dict:
         manifest = write_run_manifest(args.profile_out, config=vars(args))
         print(f"merged {key} into {args.profile_out} (+ {manifest})", file=sys.stderr)
 
+    def fleet_ops_arm(model, config, params):
+        block = run_rolling_restart(model, config, params,
+                                    args.restart_replicas, args.slots,
+                                    args.seed)
+        block["preset"] = args.preset
+        return block
+
     def replica_arm(model, config, params):
         # burst workload ~6x one replica's capacity with UNIFORM generation
         # length: slots free in crisp waves, so the admission wall measures
@@ -1546,6 +1700,8 @@ def main(argv=None) -> dict:
             result["prefix_cache"] = prefix_cache_arm(model, config, profile_params)
         if args.chunked:
             result["chunked_prefill"] = chunked_arm(model, config, profile_params)
+        if args.rolling_restart:
+            result["fleet_ops"] = fleet_ops_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
@@ -1618,6 +1774,10 @@ def main(argv=None) -> dict:
         block = chunked_arm(model, config, params)
         result["chunked_prefill"] = block
         merge_section("chunked_prefill", block, result["recorded_at"])
+    if args.rolling_restart:
+        block = fleet_ops_arm(model, config, params)
+        result["fleet_ops"] = block
+        merge_section("fleet_ops", block, result["recorded_at"])
 
     tmp = args.out + ".tmp"  # atomic: a kill mid-write must not corrupt the artifact
     with open(tmp, "w") as f:

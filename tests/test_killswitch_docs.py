@@ -77,7 +77,7 @@ def test_schema_guard_detects_doc_lag(tmp_path):
     mod = _load()
     pkg = tmp_path / "perceiver_io_tpu"
     pkg.mkdir()
-    (pkg / "metrics.py").write_text('SCHEMA = "serving-metrics/v9"\n'
+    (pkg / "metrics.py").write_text('SCHEMA = "serving-metrics/v10"\n'
                                     'OLD = "serving-metrics/v8"\n')
     docs = tmp_path / "docs"
     docs.mkdir()
@@ -86,9 +86,9 @@ def test_schema_guard_detects_doc_lag(tmp_path):
     result = mod.check(repo=str(tmp_path))
     assert not result["ok"]
     fam = result["schemas"]["serving-metrics"]
-    assert not fam["ok"] and fam["newest_package_version"] == 9
+    assert not fam["ok"] and fam["newest_package_version"] == 10
     # doc catches up -> green, even with v8 still mentioned in the package
     (docs / "serving.md").write_text(
-        "## Metrics schema (`serving-metrics/v9`)\nv8 added things.\n"
+        "## Metrics schema (`serving-metrics/v10`)\nv8 added things.\n"
         "serving-metrics/v8 remains readable.\n")
     assert mod.check(repo=str(tmp_path))["ok"]

@@ -613,7 +613,7 @@ def test_metrics_v3_reader_normalizes_older_snapshots(tmp_path):
     from perceiver_io_tpu.serving import EngineMetrics, load_metrics_jsonl
     from perceiver_io_tpu.serving.metrics import SCHEMA
 
-    assert SCHEMA == "serving-metrics/v9"
+    assert SCHEMA == "serving-metrics/v10"
     path = tmp_path / "v3.jsonl"
     m = EngineMetrics(num_slots=2, jsonl_path=str(path))
     m.record_submit(0, prompt_len=3)
@@ -662,13 +662,15 @@ def _load_chaos():
     return mod
 
 
-# the journal group (and the chunked-prefill recovery scenario, which rides
-# the same subprocess kill harness) runs in its own tests below — real
-# subprocess kills and four compaction recovery cycles blow the 120s per-test
-# alarm budget when stacked on the rest of the matrix; together the tests
-# cover every scenario
+# the journal group (and the chunked-prefill recovery + migration-window
+# crash scenarios, which ride the same subprocess kill harness, plus the
+# rolling-restart scenario's two full fleet drains) runs in its own tests
+# below — real subprocess kills and four compaction recovery cycles blow the
+# 120s per-test alarm budget when stacked on the rest of the matrix;
+# together the tests cover every scenario
 _JOURNAL_CHECKS = ("journal_crash_restart", "journal_torn_tail",
-                   "journal_compaction_crash", "chunked_prefill_recovery")
+                   "journal_compaction_crash", "chunked_prefill_recovery",
+                   "migrate_crash_midflight", "rolling_restart_under_load")
 
 
 def test_chaos_check_matrix_green(tmp_path):
@@ -718,3 +720,25 @@ def test_chaos_chunked_prefill_recovery_real_sigkill():
     check = result["checks"]["chunked_prefill_recovery"]
     assert result["all_ok"], check
     assert check["prefilling_at_kill"] > 0  # the kill really landed mid-chunk
+
+
+def test_chaos_migrate_crash_midflight_real_sigkill():
+    """Fleet-ops chaos (ISSUE 15 acceptance): a child ROUTER process
+    self-SIGKILLs inside a planned migration's double-live window
+    (destination accept durable, origin journal entry still live); fleet
+    recovery dedupes by session id — every accepted session finishes
+    exactly once, f64 token-identical (greedy + sampled), zero extra
+    compiled programs, repeat-run deterministic."""
+    mod = _load_chaos()
+    result = mod.main(["--checks", "migrate_crash_midflight"])
+    assert result["all_ok"], result["checks"]["migrate_crash_midflight"]
+
+
+def test_chaos_rolling_restart_under_load():
+    """Fleet-ops chaos (ISSUE 15 acceptance, kill-free): a journaled fleet
+    takes a rolling restart under sustained load — every replica recycles,
+    no breaker trips, every accepted session finishes exactly once f64
+    token-identical to an undisturbed run, repeat-run deterministic."""
+    mod = _load_chaos()
+    result = mod.main(["--checks", "rolling_restart_under_load"])
+    assert result["all_ok"], result["checks"]["rolling_restart_under_load"]
