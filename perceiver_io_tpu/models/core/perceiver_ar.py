@@ -307,8 +307,13 @@ def _make_paged_ar_cache(
     page's tail is simply never visible. ``kv_quant="int8"`` stores the page
     pool as int8 with per-page-per-head float32 scale sidecars (the KV bytes
     per token drop ~4x vs f32; ops/paged_decode_kernel.py module docstring) —
-    the self-attention caches and everything dense stay in ``dtype``."""
-    from perceiver_io_tpu.ops.paged_decode_kernel import KV_QUANT_MODES
+    the self-attention caches and everything dense stay in ``dtype``.
+    ``kv_quant="int4"`` nibble-packs two 4-bit codes per byte, so the pool's
+    physical last dim is ``num_channels // 2`` uint8 (num_channels must be
+    even) — KV bytes per token halve again vs int8, same scale layout."""
+    from perceiver_io_tpu.ops.paged_decode_kernel import (
+        KV_QUANT_MODES, quant_mode_qbits,
+    )
 
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -321,18 +326,25 @@ def _make_paged_ar_cache(
         raise ValueError(f"kv_quant must be one of {KV_QUANT_MODES} or None, got {kv_quant!r}")
     if kv_quant is not None and num_channels % max(num_heads, 1) != 0:
         raise ValueError("num_channels must divide evenly over num_heads for per-head scales")
-    pool_dtype = jnp.int8 if kv_quant else dtype
+    qbits = quant_mode_qbits(kv_quant)
+    if kv_quant is not None and qbits == 4 and num_channels % 2 != 0:
+        raise ValueError(
+            f"kv_quant='int4' nibble-packs channel pairs: num_channels must be even, got {num_channels}"
+        )
+    pool_dtype = (jnp.uint8 if qbits == 4 else jnp.int8) if kv_quant else dtype
+    pool_channels = num_channels // 2 if (kv_quant and qbits == 4) else num_channels
     quant_fields = {}
     if kv_quant:
         quant_fields = dict(
             k_scale=jnp.zeros((num_pages, num_heads), jnp.float32),
             v_scale=jnp.zeros((num_pages, num_heads), jnp.float32),
             num_heads=num_heads,
+            qbits=qbits,
         )
     return PagedPerceiverARCache(
         ca=PagedKVCache(
-            kp=jnp.zeros((num_pages, page_size, num_channels), pool_dtype),
-            vp=jnp.zeros((num_pages, page_size, num_channels), pool_dtype),
+            kp=jnp.zeros((num_pages, page_size, pool_channels), pool_dtype),
+            vp=jnp.zeros((num_pages, page_size, pool_channels), pool_dtype),
             page_table=jnp.zeros((batch_size, pages_per_slot), jnp.int32),
             start=jnp.zeros((batch_size,), jnp.int32),
             window=max_seq_len,

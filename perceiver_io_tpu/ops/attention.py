@@ -209,6 +209,7 @@ class MultiHeadAttention(nn.Module):
         ``(start, live)`` visibility bound (``paged_visibility``) — the parity
         contract tests/test_paging.py pins."""
         from perceiver_io_tpu.ops import paged_decode_kernel as pdk
+        from perceiver_io_tpu.ops import ragged_paged_kernel as rpk
         from perceiver_io_tpu.ops.decode_kernel import ragged_decode_enabled
 
         b, n_q = q.shape[0], q.shape[1]
@@ -231,7 +232,7 @@ class MultiHeadAttention(nn.Module):
         n_phys = kv_cache.pages_per_slot * kv_cache.page_size
         if self.use_flash is not False and pdk.paged_decode_supported(
             kv_cache.page_size, num_qk, num_v, self.num_heads,
-            quantized=kv_cache.quantized,
+            quantized=kv_cache.quantized, qbits=kv_cache.qbits,
         ):
             ang = rope_k if rope_k is not None else jnp.zeros((b, n_phys, 2), jnp.float32)
             if ang.shape[0] != b:
@@ -245,6 +246,24 @@ class MultiHeadAttention(nn.Module):
                 # int8 pools: scales ride the scalar-prefetch path and the
                 # dequant fuses into the page stream (None on fp pools)
                 k_scale=kv_cache.k_scale, v_scale=kv_cache.v_scale,
+            )
+        elif self.use_flash is not False and rpk.ragged_paged_supported(
+            kv_cache.page_size, num_qk, num_v, self.num_heads,
+            quantized=kv_cache.quantized, qbits=kv_cache.qbits,
+        ):
+            # int4 pools (and anything else the legacy single-query kernel
+            # gates out but the ragged program serves): dispatch the decode
+            # batch as a ragged descriptor of full-bound items — the nibble
+            # unpack fuses into the page stream (ops/ragged_paged_kernel.py)
+            ang = rope_k if rope_k is not None else jnp.zeros((b, n_phys, 2), jnp.float32)
+            if ang.shape[0] != b:
+                ang = jnp.broadcast_to(ang, (b, *ang.shape[1:]))
+            o = rpk.fused_ragged_paged_attention(
+                q, kv_cache.kp, kv_cache.vp, kv_cache.page_table, kv_cache.start,
+                live, jnp.full((b,), kv_cache.window - 1, jnp.int32), ang,
+                kv_cache.window, skip_dead_pages=ragged_decode_enabled(),
+                k_scale=kv_cache.k_scale, v_scale=kv_cache.v_scale,
+                qbits=kv_cache.qbits,
             )
         else:
             k_full, v_full = kv_cache.gather_dense()

@@ -56,6 +56,19 @@ semantics unchanged), pinned BITWISE in interpret mode against feeding the
 XLA-dequantized f32 pool through the same kernel; ``gather_dense``/
 ``gather_slot`` dequantize for the XLA fallback and the prefill-finish so
 CPU and sharded pools serve the same layout.
+
+int4 pages (``kv_quant="int4"``): the same per-page-per-head scale layout
+with 4-bit codes — q = clip(round(x / s), ±7), s = amax / 7 — stored OFFSET
+(n = q + 8) and nibble-packed two per byte along the channel axis, so the
+pool's physical last dim is C // 2 uint8 and resident KV bytes halve again
+vs int8. Every write/gather path shares the int8 machinery through
+``_pack_codes``/``_unpack_codes``; a freshly zeroed page's bytes unpack to
+code -8 under scale 0, so the fresh-page-zeroing and quarantine contracts
+carry over byte-for-byte. The unified ragged kernel
+(ops/ragged_paged_kernel.py) fuses the nibble unpack + dequant in-stream;
+this module's legacy single-query kernel serves int8/fp only
+(``paged_decode_supported`` gates on ``qbits``) and the XLA fallback serves
+int4 wherever the ragged kernel does not run.
 """
 
 from __future__ import annotations
@@ -70,10 +83,47 @@ import jax.numpy as jnp
 from perceiver_io_tpu.ops.decode_kernel import _head_expander, _rotate_half_blockdiag
 
 # supported quantized-page modes (serving/engine.py `kv_quant` knob)
-KV_QUANT_MODES = ("int8",)
+KV_QUANT_MODES = ("int8", "int4")
 # int8 quantization: q = clip(round(x / s), -127, 127), s = amax / 127 —
 # symmetric, -128 unused so dequant never exceeds the observed amax
 _QMAX = 127.0
+# int4 quantization: q = clip(round(x / s), -7, 7), s = amax / 7 — symmetric,
+# codes stored OFFSET (n = q + 8 in [1, 15]) and nibble-packed two per byte
+# along the channel axis, so the pool's physical last dim is C // 2 uint8.
+# A zeroed byte (fresh/trash page) unpacks to code -8, which the zero scale
+# of a fresh page dequantizes to 0 — the zeroing contract carries over.
+_QMAX4 = 7.0
+
+
+def _qmax_for(qbits: int) -> float:
+    return _QMAX4 if qbits == 4 else _QMAX
+
+
+def quant_mode_qbits(kv_quant: Optional[str]) -> int:
+    """Code width of a ``kv_quant`` mode string (8 for fp/int8 pools — fp
+    pools never consult it)."""
+    return 4 if kv_quant == "int4" else 8
+
+
+def _pack_codes(vals: jax.Array, qbits: int) -> jax.Array:
+    """Integer code VALUES (f32, already clipped) -> stored pool bytes: int8
+    for 8-bit pools, offset nibble-packed uint8 (even logical channel in the
+    low nibble, odd in the high) for 4-bit pools."""
+    if qbits == 8:
+        return vals.astype(jnp.int8)
+    n = (vals.astype(jnp.int32) + 8).astype(jnp.uint8)
+    return (n[..., ::2] | (n[..., 1::2] << 4)).astype(jnp.uint8)
+
+
+def _unpack_codes(blocks: jax.Array, qbits: int) -> jax.Array:
+    """Stored pool bytes -> f32 integer code values; the channel axis is
+    restored to its LOGICAL width for 4-bit pools (inverse of _pack_codes)."""
+    if qbits == 8:
+        return blocks.astype(jnp.float32)
+    lo = (blocks & 0xF).astype(jnp.int32) - 8
+    hi = (blocks >> 4).astype(jnp.int32) - 8
+    inter = jnp.stack([lo, hi], axis=-1)
+    return inter.reshape(*blocks.shape[:-1], blocks.shape[-1] * 2).astype(jnp.float32)
 
 
 def _amax_per_head(rows: jax.Array, num_heads: int) -> jax.Array:
@@ -91,14 +141,24 @@ def _expand_scale(scale: jax.Array, d: int) -> jax.Array:
     return jnp.repeat(scale, d, axis=-1)
 
 
-def _quantize_blocks(rows_f32: jax.Array, scale: jax.Array, d: int) -> jax.Array:
-    """Quantize ``rows_f32`` (..., n, H*d) under per-head ``scale`` (..., H):
-    q = clip(round(x / s), ±127) int8; a zero scale (all-zero page) yields
-    zero bytes instead of a division blowup."""
+def _quantize_values(rows_f32: jax.Array, scale: jax.Array, d: int,
+                     qmax: float) -> jax.Array:
+    """Integer code values (f32, NOT yet stored) of ``rows_f32`` (..., n, H*d)
+    under per-head ``scale`` (..., H): q = clip(round(x / s), ±qmax); a zero
+    scale (all-zero page) yields zero codes instead of a division blowup."""
     sc = _expand_scale(scale, d)[..., None, :]
     safe = jnp.where(sc > 0, sc, 1.0)
     q = jnp.where(sc > 0, jnp.round(rows_f32 / safe), 0.0)
-    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+    return jnp.clip(q, -qmax, qmax)
+
+
+def _quantize_blocks(rows_f32: jax.Array, scale: jax.Array, d: int,
+                     qbits: int = 8) -> jax.Array:
+    """Quantize and STORE ``rows_f32`` (..., n, H*d): int8 codes for 8-bit
+    pools, nibble-packed uint8 (last dim halved) for 4-bit pools."""
+    return _pack_codes(
+        _quantize_values(rows_f32, scale, d, _qmax_for(qbits)), qbits
+    )
 
 
 class PagedKVCache(flax.struct.PyTreeNode):
@@ -133,6 +193,9 @@ class PagedKVCache(flax.struct.PyTreeNode):
     # head count of the serving attention layer — the quantization grouping
     # (scale scope = one head's channels within one page); unused (1) on fp
     num_heads: int = flax.struct.field(pytree_node=False, default=1)
+    # stored code width: 8 (int8 pools — and ignored on fp pools) or 4
+    # (nibble-packed int4 pools, physical last dim = logical channels // 2)
+    qbits: int = flax.struct.field(pytree_node=False, default=8)
 
     @property
     def page_size(self) -> int:
@@ -151,8 +214,15 @@ class PagedKVCache(flax.struct.PyTreeNode):
         return self.k_scale is not None
 
     @property
+    def num_channels(self) -> int:
+        """LOGICAL channel count H*d — int4 pools pack two codes per stored
+        byte, so their physical last dim is half this."""
+        c = self.kp.shape[-1]
+        return c * 2 if (self.quantized and self.qbits == 4) else c
+
+    @property
     def head_dim(self) -> int:
-        return self.kp.shape[-1] // self.num_heads
+        return self.num_channels // self.num_heads
 
     def append_token(self, k_new: jax.Array, v_new: jax.Array) -> "PagedKVCache":
         """Write one token's (B, 1, C) keys/values at each row's ring position
@@ -183,19 +253,21 @@ class PagedKVCache(flax.struct.PyTreeNode):
                 start=jnp.mod(self.start + 1, self.window),
             )
         h, d = self.num_heads, self.head_dim
+        qmax = _qmax_for(self.qbits)
 
         def upd(pool, scales, row):
             row = row.astype(jnp.float32)  # (B, C)
             rmax = jnp.max(jnp.abs(row.reshape(b, h, d)), axis=-1)  # (B, H)
             old = scales[page_ids]  # (B, H)
-            new = jnp.maximum(old, rmax / _QMAX)
+            new = jnp.maximum(old, rmax / qmax)
             # old == 0 (fresh page) -> ratio 0: stale tenant bytes are zeroed
             ratio = jnp.where(new > 0, old / jnp.where(new > 0, new, 1.0), 0.0)
-            pages = pool[page_ids].astype(jnp.float32)  # (B, ps, C)
+            pages = _unpack_codes(pool[page_ids], self.qbits)  # (B, ps, C)
             pages = jnp.round(pages * _expand_scale(ratio, d)[:, None, :])
-            qrow = _quantize_blocks(row[:, None, :], new, d)[:, 0]  # (B, C)
-            pages = pages.astype(jnp.int8).at[bidx, offs].set(qrow)
-            return pool.at[page_ids].set(pages), scales.at[page_ids].set(new)
+            qrow = _quantize_values(row[:, None, :], new, d, qmax)[:, 0]  # (B, C)
+            pages = pages.at[bidx, offs].set(qrow)
+            return (pool.at[page_ids].set(_pack_codes(pages, self.qbits)),
+                    scales.at[page_ids].set(new))
 
         kp, ks = upd(self.kp, self.k_scale, k_new[:, 0])
         vp, vs = upd(self.vp, self.v_scale, v_new[:, 0])
@@ -266,8 +338,8 @@ class PagedKVCache(flax.struct.PyTreeNode):
             rz = jnp.pad(rows.astype(jnp.float32), ((0, pad), (0, 0)))
             rz = jnp.where(real[:, None], rz, 0.0)
             blocks = rz.reshape(lp, ps, h * d)
-            scale = _amax_per_head(blocks, h) / _QMAX  # (lp, H)
-            qb = _quantize_blocks(blocks, scale, d)
+            scale = _amax_per_head(blocks, h) / _qmax_for(self.qbits)  # (lp, H)
+            qb = _quantize_blocks(blocks, scale, d, self.qbits)
             return (
                 pool.at[page_ids].set(qb),
                 scales.at[page_ids].set(jnp.where(block_real[:, None], scale, 0.0)),
@@ -294,9 +366,9 @@ class PagedKVCache(flax.struct.PyTreeNode):
 
         def q(blocks, pool, scales):
             bf = blocks.astype(jnp.float32)
-            scale = _amax_per_head(bf, h) / _QMAX  # (nb, H)
+            scale = _amax_per_head(bf, h) / _qmax_for(self.qbits)  # (nb, H)
             return (
-                pool.at[ids].set(_quantize_blocks(bf, scale, d)),
+                pool.at[ids].set(_quantize_blocks(bf, scale, d, self.qbits)),
                 scales.at[ids].set(scale),
             )
 
@@ -326,16 +398,16 @@ class PagedKVCache(flax.struct.PyTreeNode):
         — the exact multiply the fused kernel performs, so fallback and
         kernel read identical values)."""
         b = self.page_table.shape[0]
-        k = self.kp[self.page_table]  # (B, P, ps, C)
+        k = self.kp[self.page_table]  # (B, P, ps, C) (C//2 stored for int4)
         v = self.vp[self.page_table]
         if self.quantized:
             d = self.head_dim
-            k = k.astype(jnp.float32) * _expand_scale(
+            k = _unpack_codes(k, self.qbits) * _expand_scale(
                 self.k_scale[self.page_table], d)[:, :, None, :]
-            v = v.astype(jnp.float32) * _expand_scale(
+            v = _unpack_codes(v, self.qbits) * _expand_scale(
                 self.v_scale[self.page_table], d)[:, :, None, :]
-        return (k.reshape(b, -1, self.kp.shape[-1]),
-                v.reshape(b, -1, self.vp.shape[-1]))
+        c = self.num_channels
+        return (k.reshape(b, -1, c), v.reshape(b, -1, c))
 
     def gather_slot(self, table_row: jax.Array) -> Tuple[jax.Array, jax.Array]:
         """ONE slot's page rows in physical ring order, (1, P*ps, C) —
@@ -343,14 +415,14 @@ class PagedKVCache(flax.struct.PyTreeNode):
         slot's already-written pages through this (models/core/perceiver_ar.
         prefill_latents_paged), so its latents see exactly the bytes decode
         will gather — quantization error included, uniformly."""
-        k = self.kp[table_row]  # (P, ps, C)
+        k = self.kp[table_row]  # (P, ps, C) (C//2 stored for int4)
         v = self.vp[table_row]
         if self.quantized:
             d = self.head_dim
-            k = k.astype(jnp.float32) * _expand_scale(self.k_scale[table_row], d)[:, None, :]
-            v = v.astype(jnp.float32) * _expand_scale(self.v_scale[table_row], d)[:, None, :]
-        return (k.reshape(1, -1, self.kp.shape[-1]),
-                v.reshape(1, -1, self.vp.shape[-1]))
+            k = _unpack_codes(k, self.qbits) * _expand_scale(self.k_scale[table_row], d)[:, None, :]
+            v = _unpack_codes(v, self.qbits) * _expand_scale(self.v_scale[table_row], d)[:, None, :]
+        c = self.num_channels
+        return (k.reshape(1, -1, c), v.reshape(1, -1, c))
 
 
 def paged_visibility(start: jax.Array, live: jax.Array, window: int, n_phys: int) -> jax.Array:
@@ -367,7 +439,7 @@ def paged_visibility(start: jax.Array, live: jax.Array, window: int, n_phys: int
 
 def paged_decode_supported(
     page_size: int, num_qk: int, num_v: int, num_heads: int = 1, n_q: int = 1,
-    quantized: bool = False,
+    quantized: bool = False, qbits: int = 8,
 ) -> bool:
     """Single-query paged decode on TPU: symmetric qk/v widths, sublane-aligned
     pages. Multi-chip pools are not yet mapped onto this kernel (the paged
@@ -390,6 +462,10 @@ def paged_decode_supported(
         and page_size % 8 == 0  # sublane-aligned page blocks
         and page_size >= 8
         and (not quantized or page_size % 32 == 0)  # int8 tile alignment
+        # nibble-packed int4 pools are served by the RAGGED kernel
+        # (ops/ragged_paged_kernel.py) or the XLA fallback — this legacy
+        # single-query kernel only streams int8/fp blocks
+        and (not quantized or qbits == 8)
     )
 
 

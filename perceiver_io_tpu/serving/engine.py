@@ -111,7 +111,13 @@ request journal inert — no files touched, behavior bit-identical to
 ``journal=None`` (serving/journal.py, tests/test_journal.py);
 ``PERCEIVER_IO_TPU_DISABLE_KV_QUANT=1`` forces full-precision pages AND
 untouched served params regardless of ``kv_quant``/``weight_dtype`` —
-f64 token-identical to the pre-quantization engine (tests/test_kv_quant.py).
+f64 token-identical to the pre-quantization engine (tests/test_kv_quant.py);
+``PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK=1`` restores the composed
+per-program tick (per-rung chunk programs, per-slot finish programs, a
+separate decode dispatch) bit-identically — the unified ragged tick
+(docs/serving.md "Unified ragged tick") buffers each tick's prefill
+chunks, latent finishes, scale resets, and decode step into ONE host-built
+descriptor and dispatches ONE fused program per tick.
 
 Quantized serving (docs/serving.md "Quantized KV pages & weight serving"):
 ``kv_quant="int8"`` stores the paged KV pools as int8 with per-page-per-head
@@ -179,6 +185,7 @@ from perceiver_io_tpu.serving.paging import (
     paged_kv_enabled,
     pages_for_request,
     prefix_cache_enabled,
+    ragged_tick_enabled,
 )
 from perceiver_io_tpu.serving.quant import (
     WEIGHT_DTYPES,
@@ -678,6 +685,34 @@ class ServingEngine:
             )
         self.max_prefill_slots = (int(max_prefill_slots)
                                   if max_prefill_slots is not None else num_slots)
+        # Unified ragged tick (docs/serving.md "Unified ragged tick"; module
+        # docstring): buffer the tick's prefill chunks / latent finishes /
+        # scale resets / decode into ONE host-built descriptor and dispatch
+        # ONE fused program. Paged-only (the descriptor is page-table work);
+        # the kill-switch restores the composed per-program tick bitwise.
+        self.ragged = self.paged and ragged_tick_enabled()
+        if self.ragged:
+            # lane counts are STATIC program shapes. At most one chunk and
+            # one finish lane per slot per tick; chunked engines are further
+            # bounded by 2 x max_prefill_slots (advancing tasks plus the
+            # admissions their finishes just unblocked).
+            self._ragged_lanes = (min(num_slots, 2 * self.max_prefill_slots)
+                                  if self.chunked else num_slots)
+            # fixed chunk row capacity — chunk shapes STOP riding the bucket
+            # ladder (no per-rung programs): the chunk cap under chunking,
+            # else the window (the widest single-dispatch tail)
+            self._ragged_chunk_cap = (self.prefill_chunk_tokens
+                                      if self.chunked else self._window)
+        # per-tick ragged work buffers (host side of the descriptor); always
+        # present so _drop_tick_work and the program counters are mode-blind
+        self._tick_chunks: List[tuple] = []
+        self._tick_finishes: List[tuple] = []
+        self._tick_resets: List[tuple] = []
+        self._tick_poison: Optional[int] = None
+        self._tick_programs = 0
+        self._tick_chunk_items = 0
+        self._tick_finish_items = 0
+        self._tick_build_s = 0.0
         self._prefix_cache: Optional[PrefixCache] = None
         if prefix_cache and self.paged and prefix_cache_enabled():
             # the cache is keyed on the pool's byte layout: its mode is fixed
@@ -697,6 +732,10 @@ class ServingEngine:
         self._span_finish = f"{obs_ns}.prefill_finish"
         if self.chunked:
             self.metrics.set_chunked_prefill(self.prefill_chunk_tokens)
+        if self.paged:
+            # serving-metrics/v11: which tick dispatcher this engine runs
+            # (ragged one-program vs composed per-phase under kill-switch)
+            self.metrics.set_ragged_tick(self.ragged)
         if self._prefix_cache is not None:
             self.metrics.set_prefix_cache(self._prefix_cache.stats(), 0)
         # serving-metrics/v9 gauges: quantized-page byte economics and the
@@ -723,7 +762,17 @@ class ServingEngine:
             # the engine's own compile-count pins, as runtime budgets: one
             # decode/install/release/quarantine program ever, <= one prefill
             # program per ladder bucket (tests/test_serving.py churn test)
-            self.watchdog.watch(f"{obs_ns}.decode_step", self._jit_decode, budget=1)
+            if self.ragged:
+                # the whole steady-state tick — chunks, finishes, poison,
+                # decode — is ONE program whatever the tick mix (every phase
+                # gates on traced flags, lanes are fixed-shape). The composed
+                # per-phase jits stay built (kill-switch fallback + oracles)
+                # but are never dispatched steady-state, so they are not
+                # watched; budgets under the kill-switch are unchanged.
+                self.watchdog.watch(f"{obs_ns}.ragged_tick",
+                                    self._jit_ragged_tick, budget=1)
+            else:
+                self.watchdog.watch(f"{obs_ns}.decode_step", self._jit_decode, budget=1)
             self.watchdog.watch(f"{obs_ns}.prefill", self._jit_prefill,
                                 budget=len(self.prefill_buckets))
             # install consumes the BUCKET-shaped req_cache, so like prefill it
@@ -735,7 +784,7 @@ class ServingEngine:
             self.watchdog.watch(f"{obs_ns}.quarantine", self._jit_quarantine, budget=1)
             if self._jit_release_pages is not None:
                 self.watchdog.watch(f"{obs_ns}.release_pages", self._jit_release_pages, budget=1)
-            if self._jit_chunk_kv is not None:
+            if self._jit_chunk_kv is not None and not self.ragged:
                 # chunk programs are keyed on the chunk's covering ladder
                 # bucket; the finish consumes fixed shapes (L queries, the
                 # window's page run) so it owns exactly one program
@@ -743,7 +792,7 @@ class ServingEngine:
                                     budget=len(self.prefill_buckets))
                 self.watchdog.watch(f"{obs_ns}.prefill_finish",
                                     self._jit_prefill_finish, budget=1)
-            if self._jit_reset_scales is not None:
+            if self._jit_reset_scales is not None and not self.ragged:
                 self.watchdog.watch(f"{obs_ns}.reset_scales",
                                     self._jit_reset_scales, budget=1)
 
@@ -972,6 +1021,130 @@ class ServingEngine:
                                    temperature, top_k, top_p, do_sample, pad_id)
             return cache, state
 
+        self._jit_ragged_tick = None
+        if self.ragged:
+            cap = self._ragged_chunk_cap
+            quantized = self.kv_quant is not None
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def ragged_tick(params_, cache, state,
+                            reset_ids, any_reset,
+                            ch_ids, ch_offset, ch_count, ch_latent_start,
+                            ch_tables, any_chunk,
+                            fin_active, fin_slot, fin_tables, fin_ids, fin_n,
+                            fin_rng, fin_temp, fin_tk, fin_tp, fin_ds,
+                            fin_pad, any_finish,
+                            poison_slot, any_decode, forced, use_forced):
+                # ONE program per tick: the composed tick's phases — scale
+                # resets, prefill chunks, latent finishes, fault poison,
+                # batched decode — fused in the composed dispatch order.
+                # Every phase is gated by a TRACED any-flag (lax.cond), so
+                # one compiled program covers every tick mix and the
+                # watchdog budget is exactly 1. Per-slot state is disjoint
+                # across phases' lanes, so batching lanes that the composed
+                # path dispatched serially is f64-identical (the parity
+                # tests pin it).
+                params = dq(params_)
+
+                if quantized:
+                    cache = jax.lax.cond(
+                        any_reset,
+                        lambda c: c.replace(ca=c.ca.reset_page_scales(reset_ids)),
+                        lambda c: c, cache,
+                    )
+
+                def chunk_phase(cache):
+                    def body(cache, lane):
+                        ids, offset, count, lstart, trow = lane
+                        j = jnp.arange(cap)
+                        pos = jnp.clip(offset + j, 0, model.max_seq_len - 1)[None, :]
+                        latent_mask = ((offset + j) >= lstart)[None, :]
+                        k, v = model.apply(params, ids[None, :], pos, latent_mask,
+                                           method=type(model).prefill_chunk_kv)
+                        # inactive lanes (count 0, trash table) deposit zero
+                        # payloads on the trash page — write_rows' padding
+                        # discipline, deterministic
+                        cache = cache.replace(
+                            ca=cache.ca.write_rows(trow, offset, count, k[0], v[0])
+                        )
+                        return cache, None
+
+                    cache, _ = jax.lax.scan(
+                        body, cache,
+                        (ch_ids, ch_offset, ch_count, ch_latent_start, ch_tables),
+                    )
+                    return cache
+
+                cache = jax.lax.cond(any_chunk, chunk_phase, lambda c: c, cache)
+
+                def finish_phase(carry):
+                    def body(carry, lane):
+                        (active, slot, trow, ids, n, rng,
+                         temp, tk, tp, ds, pad) = lane
+
+                        def fin(args):
+                            cache, state = args
+                            req_logits, sa_src = model.apply(
+                                params, ids[None, :], n, cache.ca, trow,
+                                method=type(model).prefill_finish_paged,
+                            )
+                            cache = cache.install_finish(slot, trow, sa_src, n)
+                            state = _install_state(state, slot, req_logits,
+                                                   rng, temp, tk, tp, ds, pad)
+                            return cache, state
+
+                        return jax.lax.cond(active, fin, lambda a: a, carry), None
+
+                    carry, _ = jax.lax.scan(
+                        body, carry,
+                        (fin_active, fin_slot, fin_tables, fin_ids, fin_n,
+                         fin_rng, fin_temp, fin_tk, fin_tp, fin_ds, fin_pad),
+                    )
+                    return carry
+
+                cache, state = jax.lax.cond(
+                    any_finish, finish_phase, lambda a: a, (cache, state)
+                )
+                # serving.nan fault point, fused in the composed position
+                # (after finishes activate their logits, before decode reads)
+                state = jax.lax.cond(
+                    poison_slot >= 0,
+                    lambda s: s.replace(next_logits=s.next_logits.at[
+                        jnp.maximum(poison_slot, 0)].set(jnp.nan)),
+                    lambda s: s, state,
+                )
+
+                def decode_phase(args):
+                    cache, state = args
+                    # verbatim decode_step body (the composed oracle)
+                    finite = jnp.all(jnp.isfinite(state.next_logits), axis=-1) | ~state.active
+                    processed = process_logits_batched(
+                        state.next_logits, state.temperature, state.top_k, state.top_p
+                    )
+                    keys = jax.vmap(jax.random.split)(state.rng)
+                    tok = sample_token_batched(keys[:, 1], processed, state.do_sample)
+                    tok = jnp.where(state.active, tok, state.pad_id).astype(jnp.int32)
+                    tok = jnp.where(use_forced, forced, tok).astype(jnp.int32)
+                    logits_t, cache = model.apply(
+                        params, tok[:, None], cache, method=decode_method
+                    )
+                    state = state.replace(
+                        next_logits=jnp.where(state.active[:, None], logits_t[:, -1],
+                                              state.next_logits),
+                        rng=jnp.where(state.active[:, None], keys[:, 0], state.rng),
+                    )
+                    return tok, finite, cache, state
+
+                def no_decode(args):
+                    cache, state = args
+                    return (jnp.zeros((self.num_slots,), jnp.int32),
+                            jnp.ones((self.num_slots,), bool), cache, state)
+
+                return jax.lax.cond(any_decode, decode_phase, no_decode,
+                                    (cache, state))
+
+            self._jit_ragged_tick = ragged_tick
+
         self._jit_prefill = prefill_one
         self._jit_install = install_paged if self.paged else install
         self._jit_release = release
@@ -984,7 +1157,12 @@ class ServingEngine:
 
     @property
     def decode_compilations(self) -> int:
-        """Number of programs compiled for the decode step (target: 1)."""
+        """Number of programs compiled for the steady-state tick step
+        (target: 1). Under the ragged tick THE tick program is the fused
+        one — chunks, finishes, and decode in a single launch — so it is the
+        program this invariant pins; composed engines pin the decode jit."""
+        if self.ragged:
+            return self._jit_ragged_tick._cache_size()
         return self._jit_decode._cache_size()
 
     @property
@@ -1008,6 +1186,8 @@ class ServingEngine:
             jits.extend((self._jit_chunk_kv, self._jit_prefill_finish))
         if self._jit_reset_scales is not None:
             jits.append(self._jit_reset_scales)
+        if self._jit_ragged_tick is not None:
+            jits.append(self._jit_ragged_tick)
         return sum(f._cache_size() for f in jits)
 
     # ----------------------------------------------------------------- params
@@ -1382,9 +1562,16 @@ class ServingEngine:
             # fp-exact KV a fork could never reproduce from shared pages.)
             # Shorter prompts (n < max_latents) keep the classic path: they
             # have no cacheable pages, so no identity is at stake.
+            # Under the ragged tick every admission that CAN ride the
+            # descriptor does (n >= latents — the split path's floor, since
+            # the finish consumes the last L prompt tokens): its chunk and
+            # finish fuse into the tick program. Shorter prompts keep the
+            # classic prefill+install programs — the documented exception
+            # (docs/serving.md "Unified ragged tick").
             if shared_run or (self.chunked and n >= self._latents
                               and n > self.prefill_chunk_tokens) or (
-                                  self.kv_quant is not None and n >= self._latents):
+                                  self.kv_quant is not None and n >= self._latents
+                              ) or (self.ragged and n >= self._latents):
                 self._admit_split(slot, request, bucket, shared_run, t0)
                 return
             # the ONLY allocation point (serving/paging.py): the whole
@@ -1396,6 +1583,7 @@ class ServingEngine:
             self._slot_pages[slot] = page_ids
             table_row = np.zeros((self._pages_per_slot,), np.int32)
             table_row[: len(page_ids)] = page_ids  # trash-padded reservation
+        self._tick_programs += 2  # classic path: prefill + install programs
         with self._obs.span(self._span_prefill):
             ids, pad_mask = self._bucket_prompt(request, bucket)
             req_logits, req_cache = self._jit_prefill(self.params, ids, pad_mask, bucket=bucket)
@@ -1493,7 +1681,13 @@ class ServingEngine:
             # bytes). Trash-padded tail entries re-zero page 0 harmlessly.
             ids_row = np.zeros((self._pages_per_slot,), np.int32)
             ids_row[: len(private)] = private
-            self._cache = self._jit_reset_scales(self._cache, jnp.asarray(ids_row))
+            if self.ragged:
+                # rides the tick descriptor: the fused program's reset phase
+                # runs before any chunk lane, preserving composed order
+                self._tick_resets.append((slot, ids_row))
+            else:
+                self._tick_programs += 1
+                self._cache = self._jit_reset_scales(self._cache, jnp.asarray(ids_row))
         shared_tokens = shared * self.kv_page_size
         budget = (self.prefill_chunk_tokens if self.chunked
                   else max(n - shared_tokens, 1))
@@ -1537,18 +1731,33 @@ class ServingEngine:
         remaining = task.n - task.next_pos
         if remaining > 0:
             c = min(task.chunk_budget, remaining)
-            cb = self._bucket_for(c)  # chunk program shapes ride the ladder
-            ids = np.full((1, cb), request.config.pad_token_id, np.int32)
-            ids[0, :c] = request.prompt_ids[task.next_pos: task.next_pos + c]
+            self._tick_chunk_items += 1
             t0 = time.perf_counter()
-            with self._obs.span(self._span_chunk):
-                self._cache = self._jit_chunk_kv(
-                    self.params, self._cache, jnp.asarray(ids),
-                    jnp.asarray(task.next_pos, jnp.int32),
-                    jnp.asarray(c, jnp.int32),
-                    jnp.asarray(task.n - self._latents, jnp.int32),
-                    jnp.asarray(task.table_row),
+            if self.ragged:
+                # descriptor lane, FIXED row capacity — chunk shapes stop
+                # riding the bucket ladder (chunk math is row-independent and
+                # write_rows routes pad rows to the trash page, so cap-vs-
+                # ladder padding is value-identical on real rows)
+                ids = np.full((self._ragged_chunk_cap,),
+                              request.config.pad_token_id, np.int32)
+                ids[:c] = request.prompt_ids[task.next_pos: task.next_pos + c]
+                self._tick_chunks.append(
+                    (slot, ids, task.next_pos, c,
+                     task.n - self._latents, task.table_row)
                 )
+            else:
+                cb = self._bucket_for(c)  # chunk program shapes ride the ladder
+                ids = np.full((1, cb), request.config.pad_token_id, np.int32)
+                ids[0, :c] = request.prompt_ids[task.next_pos: task.next_pos + c]
+                self._tick_programs += 1
+                with self._obs.span(self._span_chunk):
+                    self._cache = self._jit_chunk_kv(
+                        self.params, self._cache, jnp.asarray(ids),
+                        jnp.asarray(task.next_pos, jnp.int32),
+                        jnp.asarray(c, jnp.int32),
+                        jnp.asarray(task.n - self._latents, jnp.int32),
+                        jnp.asarray(task.table_row),
+                    )
             task.next_pos += c
             task.chunks += 1
             if self.chunked:
@@ -1594,12 +1803,24 @@ class ServingEngine:
             bool(cfg.do_sample),
             int(cfg.pad_token_id),
         )
-        with self._obs.span(self._span_finish):
-            self._cache, self._state = self._jit_prefill_finish(
-                self.params, self._cache, self._state, slot,
-                jnp.asarray(task.table_row), jnp.asarray(ids_latent),
-                jnp.asarray(task.n, jnp.int32), request.rng, *sampling,
+        self._tick_finish_items += 1
+        if self.ragged:
+            # descriptor lane — the fused program's finish phase runs after
+            # every chunk lane (this slot's tail chunk included) and before
+            # decode, so the newly active slot decodes THIS tick, exactly
+            # like the composed path
+            self._tick_finishes.append(
+                (slot, task.table_row, ids_latent[0], task.n,
+                 np.asarray(request.rng), sampling)
             )
+        else:
+            self._tick_programs += 1
+            with self._obs.span(self._span_finish):
+                self._cache, self._state = self._jit_prefill_finish(
+                    self.params, self._cache, self._state, slot,
+                    jnp.asarray(task.table_row), jnp.asarray(ids_latent),
+                    jnp.asarray(task.n, jnp.int32), request.rng, *sampling,
+                )
         del self._prefilling[slot]
         # (donor insert already happened incrementally, chunk by chunk, in
         # _advance_prefill — by the last chunk it covered every cacheable key)
@@ -1626,6 +1847,21 @@ class ServingEngine:
                                     chunks=task.chunks,
                                     shared_pages=task.shared_pages)
 
+    def _drop_tick_work(self, slot: int) -> None:
+        """Drop a slot's buffered ragged-tick descriptors. A victim evicted
+        or preempted MID-TICK (deadline expiry, NaN quarantine, page-pressure
+        preemption all fire between the buffering pass and dispatch) must not
+        leave chunk/finish/reset lanes behind: its pages return to the free
+        list at eviction, so a stale lane would write into pages the NEXT
+        tenant already owns. Buffers never persist across ticks — they are
+        filled and drained inside one step_dispatch — so this is the only
+        seam where stale lanes could exist."""
+        self._tick_chunks = [w for w in self._tick_chunks if w[0] != slot]
+        self._tick_finishes = [w for w in self._tick_finishes if w[0] != slot]
+        self._tick_resets = [w for w in self._tick_resets if w[0] != slot]
+        if self._tick_poison == slot:
+            self._tick_poison = None
+
     def _evict(
         self, slot: int, request: ServedRequest, reason: str,
         status: RequestStatus = RequestStatus.FINISHED,
@@ -1634,6 +1870,8 @@ class ServingEngine:
         self.scheduler.release(slot)
         self._replay_slots.pop(slot, None)
         self._prefilling.pop(slot, None)  # a mid-chunk admission dies whole
+        self._drop_tick_work(slot)
+        self._tick_programs += 1
         self._state = self._jit_release(self._state, slot)
         if self.paged:
             # paged eviction: reset the slot's table to the trash page on
@@ -1642,6 +1880,7 @@ class ServingEngine:
             # list. No O(window) row zeroing — that is the point. A SHARED
             # page's release only drops this slot's reference: the prefix
             # cache and any sibling sessions keep theirs (serving/paging.py).
+            self._tick_programs += 1
             self._cache = self._jit_release_pages(self._cache, slot)
             pages = self._slot_pages[slot]
             if pages:
@@ -1817,11 +2056,15 @@ class ServingEngine:
         self._replay_slots.pop(slot, None)
         # a victim preempted MID-SPLIT-PREFILL loses the half-built chunk
         # work (no tokens were emitted, so nothing is owed): its task dies
-        # here and the re-admission chunk-prefills from scratch
+        # here and the re-admission chunk-prefills from scratch — buffered
+        # ragged lanes die with it (its pages are about to be reallocated)
         self._prefilling.pop(slot, None)
+        self._drop_tick_work(slot)
+        self._tick_programs += 1
         self._state = self._jit_release(self._state, slot)
         pages_freed = 0
         if self.paged:
+            self._tick_programs += 1
             self._cache = self._jit_release_pages(self._cache, slot)
             pages = self._slot_pages[slot]
             if pages:
@@ -2117,9 +2360,92 @@ class ServingEngine:
             if occupied is None:
                 return
             slot = occupied[0]
+        if self.ragged:
+            # stash for the fused program's poison phase — applied between
+            # the finish lanes (which activate logits) and decode, the same
+            # composed ordering, without an eager host-side device op
+            self._tick_poison = slot
+            return
         self._state = self._state.replace(
             next_logits=self._state.next_logits.at[slot].set(jnp.nan)
         )
+
+    def _dispatch_ragged(self, any_decode: bool, forced, use_forced):
+        """Pack the tick's buffered work — scale resets, prefill chunks,
+        latent finishes, fault poison, the decode flag — into the FIXED-SHAPE
+        ragged descriptor and dispatch the one fused program. Lane packing is
+        pure host-side numpy (the descriptor build time the v11 metrics
+        report); idle lanes carry trash tables / zero counts and are either
+        value-inert (chunk lanes write only the trash page) or skipped
+        outright (finish lanes gate on ``fin_active``). Returns the decode
+        outputs; when ``any_decode`` is False they are the no-decode
+        sentinels and the caller discards them."""
+        t0 = time.perf_counter()
+        lanes, cap = self._ragged_lanes, self._ragged_chunk_cap
+        P = self._pages_per_slot
+        n_ch, n_fin = len(self._tick_chunks), len(self._tick_finishes)
+        if n_ch > lanes or n_fin > lanes or len(self._tick_resets) > lanes:
+            # the lane bound is structural (one chunk + one finish per
+            # distinct slot per tick, admission-capped) — exceeding it is a
+            # scheduling bug, not load
+            raise RuntimeError(
+                f"ragged tick overflow: {n_ch} chunks / {n_fin} finishes / "
+                f"{len(self._tick_resets)} resets into {lanes} lanes"
+            )
+        reset_ids = np.zeros((lanes * P,), np.int32)
+        for i, (_slot, ids_row) in enumerate(self._tick_resets):
+            reset_ids[i * P:(i + 1) * P] = ids_row
+        ch_ids = np.zeros((lanes, cap), np.int32)
+        ch_offset = np.zeros((lanes,), np.int32)
+        ch_count = np.zeros((lanes,), np.int32)
+        # idle-lane latent_start far beyond any position: latent mask all
+        # False, so the lane's (trash-bound) payload takes the cheap path
+        ch_lstart = np.full((lanes,), 2 ** 30, np.int32)
+        ch_tables = np.zeros((lanes, P), np.int32)  # all-trash tables
+        for i, (_slot, ids, off, c, lstart, trow) in enumerate(self._tick_chunks):
+            ch_ids[i] = ids
+            ch_offset[i] = off
+            ch_count[i] = c
+            ch_lstart[i] = lstart
+            ch_tables[i] = trow
+        fin_active = np.zeros((lanes,), bool)
+        fin_slot = np.zeros((lanes,), np.int32)
+        fin_tables = np.zeros((lanes, P), np.int32)
+        fin_ids = np.zeros((lanes, self._latents), np.int32)
+        fin_n = np.zeros((lanes,), np.int32)
+        fin_rng = np.zeros((lanes, 2), np.uint32)
+        fin_temp = np.ones((lanes,), np.float32)
+        fin_tk = np.zeros((lanes,), np.int32)
+        fin_tp = np.ones((lanes,), np.float32)
+        fin_ds = np.zeros((lanes,), bool)
+        fin_pad = np.zeros((lanes,), np.int32)
+        for i, (slot, trow, ids_latent, n, rng, sampling) in enumerate(self._tick_finishes):
+            fin_active[i] = True
+            fin_slot[i] = slot
+            fin_tables[i] = trow
+            fin_ids[i] = ids_latent
+            fin_n[i] = n
+            fin_rng[i] = rng
+            fin_temp[i], fin_tk[i], fin_tp[i], fin_ds[i], fin_pad[i] = sampling
+        poison = -1 if self._tick_poison is None else int(self._tick_poison)
+        self._tick_build_s = time.perf_counter() - t0
+        self._tick_programs += 1
+        tok, finite, self._cache, self._state = self._jit_ragged_tick(
+            self.params, self._cache, self._state,
+            jnp.asarray(reset_ids), bool(self._tick_resets),
+            jnp.asarray(ch_ids), jnp.asarray(ch_offset), jnp.asarray(ch_count),
+            jnp.asarray(ch_lstart), jnp.asarray(ch_tables), bool(n_ch),
+            jnp.asarray(fin_active), jnp.asarray(fin_slot),
+            jnp.asarray(fin_tables), jnp.asarray(fin_ids), jnp.asarray(fin_n),
+            jnp.asarray(fin_rng), jnp.asarray(fin_temp), jnp.asarray(fin_tk),
+            jnp.asarray(fin_tp), jnp.asarray(fin_ds), jnp.asarray(fin_pad),
+            bool(n_fin), poison, bool(any_decode), forced, use_forced,
+        )
+        self._tick_chunks.clear()
+        self._tick_finishes.clear()
+        self._tick_resets.clear()
+        self._tick_poison = None
+        return tok, finite
 
     # -------------------------------------------------------------------- step
     def step_dispatch(self) -> bool:
@@ -2148,6 +2474,19 @@ class ServingEngine:
         # would sit in the recorder's open-span stack forever).
         self._obs.span_begin(self._span_tick)
         try:
+            # per-tick program/work accounting (serving-metrics/v11
+            # ragged_tick block). Buffers are re-cleared defensively: they
+            # drain inside this method, so leftovers can only mean a prior
+            # tick died between buffering and dispatch — stale lanes would
+            # reference pages that eviction has since recycled.
+            self._tick_programs = 0
+            self._tick_chunk_items = 0
+            self._tick_finish_items = 0
+            self._tick_build_s = 0.0
+            self._tick_chunks.clear()
+            self._tick_finishes.clear()
+            self._tick_resets.clear()
+            self._tick_poison = None
             self.scheduler.advance_tick()  # the priority-aging clock (int add)
             if self._deadlines_seen:
                 self._expire_deadlines(time.perf_counter())
@@ -2196,7 +2535,14 @@ class ServingEngine:
             # are claimed for every scheduler purpose but must not be
             # harvested — the decode step would hand them pad tokens
             occupied = [(s, r) for s, r in occupied if s not in self._prefilling]
-            if not occupied:
+            tick_work = bool(self._tick_chunks or self._tick_finishes
+                             or self._tick_resets)
+            if not occupied and not tick_work:
+                if self._tick_programs:
+                    # eviction/admission programs ran but nothing decodes:
+                    # still a dispatching tick for the programs-per-tick view
+                    self.metrics.record_tick_dispatch(
+                        self._tick_programs, 0, 0, 0, 0.0)
                 self._obs.span_end(self._span_tick)
                 return False
 
@@ -2210,12 +2556,31 @@ class ServingEngine:
             else:
                 forced, use_forced = self._forced_none, self._use_forced_none
             t0 = time.perf_counter()
-            with self._obs.span(self._span_decode_dispatch):
-                # dispatch only — the jit call returns before the device step
-                # finishes; the device cost lands in the sample-sync at harvest
-                tok, finite, self._cache, self._state = self._jit_decode(
-                    self.params, self._cache, self._state, forced, use_forced
-                )
+            if self.ragged:
+                with self._obs.span(self._span_decode_dispatch):
+                    # the tick's ONE program: resets + chunks + finishes +
+                    # poison + decode, fused (docs/serving.md "Unified
+                    # ragged tick")
+                    tok, finite = self._dispatch_ragged(bool(occupied),
+                                                        forced, use_forced)
+            else:
+                self._tick_programs += 1
+                with self._obs.span(self._span_decode_dispatch):
+                    # dispatch only — the jit call returns before the device step
+                    # finishes; the device cost lands in the sample-sync at harvest
+                    tok, finite, self._cache, self._state = self._jit_decode(
+                        self.params, self._cache, self._state, forced, use_forced
+                    )
+            self.metrics.record_tick_dispatch(
+                self._tick_programs, self._tick_chunk_items,
+                self._tick_finish_items, len(occupied), self._tick_build_s,
+            )
+            if not occupied:
+                # ragged tick that only carried prefill work: nothing to
+                # harvest (the finish lanes activate slots for NEXT tick's
+                # decode when the tail chunk and finish split across ticks)
+                self._obs.span_end(self._span_tick)
+                return False
         except BaseException:
             self._obs.span_end(self._span_tick)
             raise
@@ -2303,10 +2668,12 @@ class ServingEngine:
                             pages = [p for p in pages
                                      if self._pool.refcount(p) < 2]
                         row[: len(pages)] = pages
+                        self._tick_programs += 1
                         self._cache = self._jit_quarantine(
                             self._cache, slot, jnp.asarray(row)
                         )
                     else:
+                        self._tick_programs += 1
                         self._cache = self._jit_quarantine(self._cache, slot)
                     self._evict(slot, request, "nonfinite_logits",
                                 status=RequestStatus.FAILED)

@@ -122,6 +122,19 @@ Schema history:
     ``deploy`` / ``rollback`` / ``autoscale`` events, and ``submit`` /
     ``finish`` events on version-pinned routers carry a ``version`` field.
     The reader normalizes pre-v10 snapshots with ``None``.
+  * ``serving-metrics/v11`` — the unified-ragged-tick schema (docs/serving.md
+    "Unified ragged tick"): every snapshot carries a ``ragged_tick`` field —
+    ``None`` on dense engines and on router snapshots (tick dispatch is
+    per-engine), else ``enabled`` (False under the
+    ``PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK`` kill-switch — the composed
+    per-phase dispatcher), ``ticks`` (dispatching ticks recorded),
+    ``programs_per_tick`` p50/p95 (the headline gauge: 1 steady-state when
+    ragged, the per-phase sum when composed), ``chunk_items`` /
+    ``finish_items`` / ``decode_items`` p50/p95 (the mixed-batch
+    composition per tick), and ``descriptor_build_s`` p50/p95 (host-side
+    lane packing; 0 when composed). The stream is unchanged — the block is
+    windowed gauges only. The reader normalizes pre-v11 snapshots with
+    ``None``.
 """
 
 from __future__ import annotations
@@ -134,7 +147,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v10"
+SCHEMA = "serving-metrics/v11"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -146,6 +159,7 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v8",
     "serving-metrics/v9",
     "serving-metrics/v10",
+    "serving-metrics/v11",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
@@ -158,6 +172,7 @@ _PRE_V7 = KNOWN_SCHEMAS[:6]
 _PRE_V8 = KNOWN_SCHEMAS[:7]
 _PRE_V9 = KNOWN_SCHEMAS[:8]
 _PRE_V10 = KNOWN_SCHEMAS[:9]
+_PRE_V11 = KNOWN_SCHEMAS[:10]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -257,6 +272,10 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # pre-v10 writers had no fleet-operations layer; None also
                 # matches a newer plain engine's truthful "no fleet"
                 snap.setdefault("fleet_ops", None)
+            if schema in _PRE_V11:
+                # pre-v11 writers had no unified ragged tick; None also
+                # matches a newer DENSE engine's truthful "no tick dispatcher"
+                snap.setdefault("ragged_tick", None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -369,6 +388,17 @@ class EngineMetrics(_JsonlMetrics):
     agreement_matched: int = 0
     # weight-serving gauges (serving-metrics/v9): None <=> params untouched
     weight_serving: Optional[Dict] = None
+    # unified-ragged-tick gauges (serving-metrics/v11): ragged_enabled None
+    # <=> dense engine (no tick dispatcher) and snapshots report
+    # ragged_tick: None; False <=> paged engine running the composed
+    # per-phase dispatcher (the kill-switch comparison arm)
+    ragged_enabled: Optional[bool] = None
+    ragged_ticks: int = 0
+    _tick_program_counts: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _tick_chunk_counts: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _tick_finish_counts: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _tick_decode_counts: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
+    _tick_build_times: Deque[float] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
     _start_time: Optional[float] = None
     _occupancy_sum: float = 0.0  # sum over steps of active_slots / num_slots
     _pages_per_request: Deque[int] = field(default_factory=lambda: deque(maxlen=LATENCY_WINDOW))
@@ -477,6 +507,32 @@ class EngineMetrics(_JsonlMetrics):
         self.agreement_matched += int(matched)
         self.agreement_tokens += int(total)
         self._emit("quant_agreement", matched=int(matched), total=int(total))
+
+    def set_ragged_tick(self, enabled: bool) -> None:
+        """Mark a paged engine's tick dispatcher (serving-metrics/v11):
+        snapshots report the ragged_tick section instead of None. ``enabled``
+        False means the composed per-phase dispatcher is live (the
+        ``PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK`` kill-switch) — its
+        per-tick program counts are recorded through the same gauges, which
+        is exactly the 1-vs-N comparison the bench reads."""
+        self.ragged_enabled = bool(enabled)
+
+    def record_tick_dispatch(self, programs: int, chunk_items: int,
+                             finish_items: int, decode_items: int,
+                             build_s: float) -> None:
+        """One DISPATCHING tick's program/work accounting (v11): how many
+        compiled programs the tick launched (ragged steady-state: exactly 1),
+        the tick's mixed-batch composition (prefill chunk lanes, latent
+        finish lanes, decoding slots), and the host-side descriptor build
+        time (0 when composed — there is no descriptor). Windowed, no JSONL
+        event: this fires every tick, and the stream already carries
+        decode_step/chunk events for per-tick forensics."""
+        self.ragged_ticks += 1
+        self._tick_program_counts.append(int(programs))
+        self._tick_chunk_counts.append(int(chunk_items))
+        self._tick_finish_counts.append(int(finish_items))
+        self._tick_decode_counts.append(int(decode_items))
+        self._tick_build_times.append(float(build_s))
 
     def set_weight_serving(self, dtype: str, param_bytes: int,
                            param_bytes_fp: int) -> None:
@@ -674,6 +730,33 @@ class EngineMetrics(_JsonlMetrics):
             # autoscale) is a ROUTER behavior — a plain engine truthfully
             # has none (same reading as a pre-v10 snapshot)
             "fleet_ops": None,
+            # v11: None on dense engines (no tick dispatcher exists — same
+            # reading as a pre-v11 snapshot); on paged engines the per-tick
+            # program/work gauges, whichever dispatcher is live
+            "ragged_tick": None if self.ragged_enabled is None else {
+                "enabled": self.ragged_enabled,
+                "ticks": self.ragged_ticks,
+                "programs_per_tick": {
+                    k: v for k, v in _latency_dict(self._tick_program_counts).items()
+                    if k in _PERCENTILE_KEYS
+                },
+                "chunk_items": {
+                    k: v for k, v in _latency_dict(self._tick_chunk_counts).items()
+                    if k in _PERCENTILE_KEYS
+                },
+                "finish_items": {
+                    k: v for k, v in _latency_dict(self._tick_finish_counts).items()
+                    if k in _PERCENTILE_KEYS
+                },
+                "decode_items": {
+                    k: v for k, v in _latency_dict(self._tick_decode_counts).items()
+                    if k in _PERCENTILE_KEYS
+                },
+                "descriptor_build_s": {
+                    k: v for k, v in _latency_dict(self._tick_build_times).items()
+                    if k in _PERCENTILE_KEYS
+                },
+            },
             # v5: None on dense engines (no pool exists — same reading as a
             # pre-v5 snapshot), real gauges on paged engines
             "page_pool": None if self.pages_total is None else {
@@ -900,6 +983,7 @@ class RouterMetrics(_JsonlMetrics):
             "chunked_prefill": None,
             "kv_quant": None,
             "weight_serving": None,
+            "ragged_tick": None,
             # v10: the fleet-operations gauges (docs/serving.md "Fleet
             # operations") — the router owns the lifecycle, so unlike the
             # per-engine sections above this one is real HERE. The rollout

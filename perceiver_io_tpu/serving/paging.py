@@ -87,6 +87,17 @@ def kv_quant_enabled() -> bool:
     return os.environ.get("PERCEIVER_IO_TPU_DISABLE_KV_QUANT", "0").lower() in ("0", "false", "")
 
 
+def ragged_tick_enabled() -> bool:
+    """Kill-switch for the unified ragged tick (docs/serving.md "Unified
+    ragged tick"): ``PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK=1`` restores the
+    composed per-program tick — per-rung chunk programs, per-slot finish
+    programs, a separate decode dispatch — BIT-identically (the composed
+    path stays compiled-in as the fallback and correctness oracle;
+    tests/test_ragged_tick.py pins tokens both ways). Checked at engine
+    construction, like the paged-KV switch."""
+    return os.environ.get("PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK", "0").lower() in ("0", "false", "")
+
+
 def chunked_prefill_enabled() -> bool:
     """Kill-switch for chunked admission prefill:
     ``PERCEIVER_IO_TPU_DISABLE_CHUNKED_PREFILL=1`` pins every admission to

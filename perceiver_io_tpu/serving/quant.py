@@ -140,5 +140,7 @@ def kv_bytes_per_token(num_channels: int, cache_dtype, kv_quant: Optional[str],
     fp = 2 * num_channels * jnp.dtype(cache_dtype).itemsize
     if kv_quant is None:
         return float(fp), float(fp)
-    served = 2 * num_channels * 1 + 2 * num_heads * 4 / page_size
+    # int8: one byte per channel; int4: two nibble-packed codes per byte
+    code_bytes = 0.5 if kv_quant == "int4" else 1.0
+    served = 2 * num_channels * code_bytes + 2 * num_heads * 4 / page_size
     return float(fp), float(served)

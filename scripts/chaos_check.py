@@ -71,6 +71,14 @@ exercised on every change, not just when production finds them:
                            alone, f64 token-identical to an uninterrupted
                            dense run (scripts/journal_crash_harness.py
                            --chunked)
+  * ``ragged_tick_churn``  quarantine + priority preemption INSIDE the
+                           fused ragged tick under page pressure: the
+                           poisoned slot's buffered descriptor lanes drop
+                           with it, survivors finish f64 token-identical
+                           to the COMPOSED kill-switch engine running
+                           uncontended, repeat runs pin statuses/tokens/
+                           victims, and the drain leaves the free list
+                           whole and the tick buffers empty
 
   * ``rolling_restart_under_load`` (kill-free) a journaled 2-replica fleet
                            takes a rolling restart while requests keep
@@ -944,6 +952,122 @@ def check_chunked_prefill_recovery() -> dict:
     }
 
 
+def check_ragged_tick_churn() -> dict:
+    """Fault churn INSIDE the unified ragged tick (docs/serving.md "Unified
+    ragged tick"): with the fused one-program tick live (the paged default),
+    a poisoned slot is quarantined out of a MIXED tick — its buffered
+    descriptor lanes dropped with it — while chunked prefill lanes are still
+    streaming, and a high-priority request then admits via preemption under
+    page pressure. Every survivor finishes f64 token-identical to the
+    COMPOSED per-program engine running uncontended (the kill-switch arm is
+    the correctness oracle, not a convenience), repeat runs pin statuses,
+    tokens AND victim identity, and the drain leaves the free list whole
+    and the tick buffers empty — a dropped lane leaks no page."""
+    kill = "PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK"
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+        # short (classic path, n < latents), window-length chunk-streamed,
+        # and the high-priority head — plus the doomed poisoned session
+        survivor_prompts = [[4, 5, 6], list(range(1, 11)), [7] * 12]
+        new = [4, 3, 5]
+        # n < latents: the classic prefill+install path, so the slot is
+        # ACTIVE (installed logits) when the poison fires — a mid-split slot
+        # has no decode state to poison yet
+        poisoned_prompt = [20, 21, 22]
+
+        def build(composed, **kw):
+            prev = os.environ.pop(kill, None)
+            if composed:
+                os.environ[kill] = "1"
+            try:
+                return _engine(model, params, num_slots=3, kv_page_size=2, **kw)
+            finally:
+                if prev is None:
+                    os.environ.pop(kill, None)
+                else:
+                    os.environ[kill] = prev
+
+        def reference():
+            # composed per-program engine, ample pool, no faults: the oracle
+            engine = build(True)
+            assert not engine.ragged
+            hs = [engine.submit(p, max_new_tokens=m, rng=jax.random.PRNGKey(i))
+                  for i, (p, m) in enumerate(zip(survivor_prompts, new))]
+            engine.run_until_drained(max_steps=300)
+            assert all(h.ok for h in hs)
+            tokens = [h.result().tolist() for h in hs]
+            engine.close()
+            return tokens
+
+        def churn():
+            # 17 pages (16 allocatable): short (bucket 6 + 4 new = 5 pages)
+            # + the chunk-streamed session (6) + poisoned (5) fill the pool
+            # exactly; the quarantine hands 5 back, one short of the hi
+            # head's 6 — the head page-blocks and must preempt, all while
+            # chunk lanes are still streaming
+            engine = build(False, num_kv_pages=17,
+                           prefill_chunk_tokens=4, max_prefill_slots=2)
+            assert engine.ragged
+            short = engine.submit(survivor_prompts[0], max_new_tokens=new[0],
+                                  rng=jax.random.PRNGKey(0))
+            long = engine.submit(survivor_prompts[1], max_new_tokens=new[1],
+                                 rng=jax.random.PRNGKey(1))
+            poisoned = engine.submit(poisoned_prompt, max_new_tokens=4,
+                                     rng=jax.random.PRNGKey(9))
+            for _ in range(6):  # classic-path poisoned slot active; long
+                engine.step()   # still mid chunk-stream (deterministic walk)
+                if poisoned.status.value == "running":
+                    break
+            assert poisoned.status.value == "running"
+            with armed("serving.nan", slot=poisoned.slot):
+                engine.step()  # poison folds into a MIXED fused tick
+            hi = engine.submit(survivor_prompts[2], max_new_tokens=new[2],
+                               rng=jax.random.PRNGKey(2), priority=2)
+            engine.run_until_drained(max_steps=400)
+            handles = [short, long, hi]
+            victims = [i for i, h in enumerate(handles) if h.preemptions > 0]
+            snap = engine.metrics.snapshot()
+            out = {
+                "statuses": ([h.status.value for h in handles]
+                             + [poisoned.status.value]),
+                "tokens": [h.result().tolist() for h in handles],
+                "victims": victims,
+                "preemptions": snap["preemptions"],
+                "failed": snap["failed"],
+                "ragged_p50": snap["ragged_tick"]["programs_per_tick"]["p50"],
+                "free_list_whole": engine._pool.pages_in_use == 0,
+                "buffers_empty": not (engine._tick_chunks
+                                      or engine._tick_finishes
+                                      or engine._tick_resets),
+            }
+            engine.close()
+            return out
+
+        expected = reference()
+        r1, r2 = churn(), churn()
+
+    survivors_identical = r1["tokens"] == expected
+    return {
+        "ok": (
+            r1["statuses"] == ["finished", "finished", "finished", "failed"]
+            and survivors_identical
+            and r1 == r2
+            and r1["failed"] == 1
+            and r1["preemptions"] >= 1
+            and r1["free_list_whole"]
+            and r1["buffers_empty"]
+        ),
+        "statuses": r1["statuses"],
+        "survivors_identical_to_composed_uncontended": survivors_identical,
+        "deterministic_repeat": r1 == r2,
+        "victims": r1["victims"],
+        "preemptions": r1["preemptions"],
+        "programs_per_tick_p50": r1["ragged_p50"],
+        "free_list_whole": r1["free_list_whole"],
+        "tick_buffers_empty": r1["buffers_empty"],
+    }
+
+
 def check_rolling_restart_under_load() -> dict:
     """Zero-downtime fleet ops (docs/serving.md "Fleet operations"): a
     journaled 2-replica fleet takes a rolling restart UNDER LOAD — requests
@@ -1212,6 +1336,7 @@ CHECKS = {
     "journal_compaction_crash": check_journal_compaction_crash,
     "prefix_fork_churn": check_prefix_fork_churn,
     "chunked_prefill_recovery": check_chunked_prefill_recovery,
+    "ragged_tick_churn": check_ragged_tick_churn,
     "router_crash_failover": check_router_crash_failover,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,

@@ -259,6 +259,9 @@ def report_serving_metrics(path: str) -> Dict:
         # serving-metrics/v10 fleet-operations gauges (None: plain engine
         # or pre-v10 stream; real on router snapshots)
         out["fleet_ops"] = snap.get("fleet_ops")
+        # serving-metrics/v11 unified-ragged-tick gauges (None: dense
+        # engine, router snapshot, or pre-v11 stream)
+        out["ragged_tick"] = snap.get("ragged_tick")
         migrations = [e for e in loaded["events"] if e.get("event") == "migrate"]
         if migrations:
             out["migrate_events"] = {
@@ -405,6 +408,22 @@ def main(argv=None) -> Dict:
             ratio = f"{served / fp_b:.2f}x fp" if fp_b else "n/a"
             print("weight serving: "
                   f"dtype={ws.get('dtype')}, params {served} bytes ({ratio})")
+        # v11 unified-ragged-tick rendering (suppressed where the reader
+        # normalized to None: dense engine, router, pre-v11 stream) — the
+        # programs-per-tick headline an operator checks before trusting the
+        # one-launch steady state, plus the tick's mixed-batch composition
+        rt = section.get("ragged_tick")
+        if rt:
+            ppt = rt.get("programs_per_tick") or {}
+            build = rt.get("descriptor_build_s") or {}
+            print("ragged tick: "
+                  f"{'ragged' if rt.get('enabled') else 'composed (kill-switch)'}, "
+                  f"{rt.get('ticks')} dispatching ticks, "
+                  f"programs/tick p50={ppt.get('p50')} p95={ppt.get('p95')}, "
+                  f"descriptor build p95={build.get('p95')}s")
+            for key in ("chunk_items", "finish_items", "decode_items"):
+                stats = rt.get(key) or {}
+                print(f"  {key}: p50={stats.get('p50')} p95={stats.get('p95')}")
         # v10 fleet-operations rendering (suppressed where the reader
         # normalized to None: plain engine or pre-v10 stream) — the
         # migration/recycle/rollout/autoscale story an operator audits

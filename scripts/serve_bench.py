@@ -1291,6 +1291,258 @@ def run_chunked_interference(model, config, params, num_slots: int, seed: int,
     }
 
 
+def run_ragged_tick_bench(model, config, params, num_slots: int, seed: int,
+                          repeats: int = 7) -> dict:
+    """``--ragged`` arm (docs/serving.md "Unified ragged tick"): the fused
+    ONE-program tick vs the composed per-program tick the
+    PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK kill-switch restores, on a
+    sustained MIXED workload — background decode streams plus recurring
+    window-length prompt bursts admitted through chunked prefill, so steady
+    ticks genuinely carry chunk lanes, latent finishes AND batched decode at
+    once (the shape class the ragged tick exists for; a decode-only
+    workload would show nothing). Reported per arm, interleaved
+    median-of-``repeats``: decode tokens/s, running-slot inter-token
+    p50/p95, and the headline 1-vs-N contrast — programs per dispatching
+    tick from the v11 ``ragged_tick`` metrics block (plus descriptor build
+    time on the ragged arm; the host-side cost the single dispatch buys).
+    Greedy tokens must be IDENTICAL across the arms on the bench workload
+    (the f64 engine-level pin lives in tests/test_ragged_tick — this
+    re-checks at serving dtype under timing pressure).
+
+    A second section prices the new int4 pages: CONCURRENT SESSIONS PER
+    FIXED POOL BYTE BUDGET, int4 vs int8 vs full-precision pages — the same
+    budget discipline as the --kv-quant arm (per-page-per-head f32 scale
+    sidecars honestly counted inside the budget; int4 packs two offset
+    codes per byte so its KV term is half int8's), with greedy token
+    agreement vs the fp arm so quality is not silently dropped.
+    Acceptance: the int4 arm holds >= 1.8x the fp arm's sessions."""
+    from perceiver_io_tpu.serving import ServingEngine, pages_for_request
+    from perceiver_io_tpu.serving.engine import default_prefill_buckets
+
+    KILL = "PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK"
+    window = config.max_seq_len
+    page_size = max(window // 16, 2)
+    chunk = max(window // 8, 1)
+    n_bg = max(num_slots - 1, 2)
+    burst_size = 2
+    burst_every = 8  # ticks between bursts: sustained mixing, not one-off
+    n_bursts = 3
+    slots = n_bg + burst_size + 1
+    need = pages_for_request(window, 8, window, page_size)
+    num_pages = (slots + 1) * need + 1
+    rng = np.random.RandomState(seed)
+    bg_prompts = [rng.randint(1, config.vocab_size,
+                              size=int(rng.randint(4, max(window // 8, 5)))).tolist()
+                  for _ in range(n_bg)]
+    bg_max_new = 32
+    burst_max_new = 4
+    burst_prompts = [rng.randint(1, config.vocab_size, size=window).tolist()
+                     for _ in range(burst_size * n_bursts)]
+
+    def build(composed: bool) -> ServingEngine:
+        # the mode knob is read at construction: toggle the kill-switch
+        # around the ctor only, and restore the ambient env either way
+        prev = os.environ.pop(KILL, None)
+        if composed:
+            os.environ[KILL] = "1"
+        try:
+            # telemetry=False: ambient env must not record inside a TIMED arm
+            return ServingEngine(
+                model, params, num_slots=slots, kv_page_size=page_size,
+                num_kv_pages=num_pages,
+                max_queue_depth=4 * len(burst_prompts),
+                prefill_chunk_tokens=chunk, max_prefill_slots=2,
+                telemetry=False)
+        finally:
+            if prev is None:
+                os.environ.pop(KILL, None)
+            else:
+                os.environ[KILL] = prev
+
+    def one_pass(engine):
+        bg = [engine.submit(p, max_new_tokens=bg_max_new,
+                            rng=jax.random.PRNGKey(i))
+              for i, p in enumerate(bg_prompts)]
+        for _ in range(4):  # background admitted and decoding
+            engine.step()
+        t0 = time.perf_counter()
+        lhs, gaps, last, tick = [], [], t0, 0
+        while any(not h.done for h in bg):
+            if tick % burst_every == 0 and len(lhs) < len(burst_prompts):
+                base = len(lhs)  # captured: extend() would read it lazily
+                lhs.extend([engine.submit(p, max_new_tokens=burst_max_new,
+                                          rng=jax.random.PRNGKey(99 + base + i))
+                            for i, p in enumerate(
+                                burst_prompts[base:base + burst_size])])
+            engine.step()
+            tick += 1
+            now = time.perf_counter()
+            gaps.append(now - last)
+            last = now
+        while engine.step():
+            pass
+        drain = time.perf_counter() - t0
+        assert all(h.ok for h in bg) and all(h.ok for h in lhs)
+        engine.finished.clear()
+        return sorted(gaps), drain, [h.result().tolist() for h in bg + lhs]
+
+    engines = {"ragged": build(False), "composed": build(True)}
+    assert engines["ragged"].ragged and not engines["composed"].ragged
+    for engine in engines.values():  # warmup compiles every program
+        one_pass(engine)
+    samples = {n: [] for n in engines}
+    tokens_by_arm = {}
+    for _ in range(repeats):
+        for name, engine in engines.items():  # interleaved A/B
+            gaps, drain, toks = one_pass(engine)
+            samples[name].append((gaps, drain))
+            tokens_by_arm[name] = toks
+
+    new_tokens = bg_max_new * len(bg_prompts) + burst_max_new * len(burst_prompts)
+    arms = {}
+    for name, engine in engines.items():
+        drain = _median([s[1] for s in samples[name]])
+        rt = engine.metrics.snapshot()["ragged_tick"]
+        arms[name] = {
+            "tokens_per_s": round(new_tokens / drain, 2) if drain > 0 else 0.0,
+            "drain_wall_seconds": round(drain, 4),
+            "inter_token_p50_s": round(
+                _median([_pct(s[0], 0.50) for s in samples[name]]), 4),
+            "inter_token_p95_s": round(
+                _median([_pct(s[0], 0.95) for s in samples[name]]), 4),
+            "dispatching_ticks": rt["ticks"],
+            "programs_per_tick": rt["programs_per_tick"],
+            "descriptor_build_s": rt["descriptor_build_s"],
+            "tick_compilations": engine.decode_compilations,
+        }
+        engine.close()
+
+    # --- int4 capacity: sessions per fixed pool BYTE budget, three arms.
+    # The budget is the fp arm's pool bytes; every arm spends the same
+    # bytes on its own page format + sidecars and raises its slot count to
+    # what its pool holds resident (the --kv-quant arm's discipline).
+    pages_per_slot = -(-window // page_size)
+    num_pages_fp = num_slots * pages_per_slot + 1
+    page_bytes = {
+        "fp": 2 * page_size * config.num_channels * 4,
+        "int8": (2 * page_size * config.num_channels
+                 + 2 * config.num_heads * 4),
+        "int4": (page_size * config.num_channels  # two codes per byte
+                 + 2 * config.num_heads * 4),
+    }
+    budget_bytes = num_pages_fp * page_bytes["fp"]
+    short_hi = max(window // 8, 2)
+    buckets = default_prefill_buckets(window, config.max_latents)
+    covering = next(b for b in buckets if b >= short_hi)
+    cap_need = pages_for_request(covering, 8, window, page_size)
+    cap_engines, cap_meta = {}, {}
+    for name, pb in page_bytes.items():
+        n_pages = budget_bytes // pb
+        n_slots = max((n_pages - 1) // cap_need, 1)
+        cap_engines[name] = ServingEngine(
+            model, params, num_slots=n_slots, kv_page_size=page_size,
+            num_kv_pages=n_pages, kv_quant=None if name == "fp" else name,
+            telemetry=False)
+        cap_meta[name] = {"slots": int(n_slots), "num_kv_pages": int(n_pages),
+                          "pool_bytes": int(n_pages * pb)}
+    k = 2 * max(e.num_slots for e in cap_engines.values())
+    cap_prompts = [rng.randint(1, config.vocab_size, size=int(n)).tolist()
+                   for n in rng.randint(2, short_hi + 1, size=k)]
+
+    def cap_pass(engine):
+        t0 = time.perf_counter()
+        hs = [engine.submit(p, max_new_tokens=8, rng=jax.random.PRNGKey(i))
+              for i, p in enumerate(cap_prompts)]
+        peak = 0
+        while engine.step():
+            peak = max(peak, engine.scheduler.active_slots)
+        wall = time.perf_counter() - t0
+        assert all(h.ok for h in hs)  # a degraded pass must not be timed
+        engine.finished.clear()
+        return peak, wall, [h.result().tolist() for h in hs]
+
+    for engine in cap_engines.values():  # warmup
+        cap_pass(engine)
+    peaks = {n: [] for n in cap_engines}
+    cap_walls = {n: [] for n in cap_engines}
+    cap_tokens = {}
+    for _ in range(repeats):
+        for name, engine in cap_engines.items():  # interleaved
+            peak, wall, toks = cap_pass(engine)
+            peaks[name].append(peak)
+            cap_walls[name].append(wall)
+            cap_tokens[name] = toks
+
+    # greedy agreement, int4 arm vs fp arm (identical prompts and rngs)
+    total = matched = exact = 0
+    for a, b in zip(cap_tokens["fp"], cap_tokens["int4"]):
+        total += max(len(a), len(b))
+        matched += sum(1 for x, y in zip(a, b) if x == y)
+        exact += a == b
+    cap_arms = {}
+    for name, engine in cap_engines.items():
+        cap_arms[name] = {
+            **cap_meta[name],
+            "peak_concurrent_sessions": _median(peaks[name]),
+            "drain_wall_seconds": round(_median(cap_walls[name]), 4),
+            "kv_quant": engine.metrics.snapshot()["kv_quant"],
+        }
+        engine.close()
+    fp_peak = cap_arms["fp"]["peak_concurrent_sessions"]
+    i8_peak = cap_arms["int8"]["peak_concurrent_sessions"]
+    i4_peak = cap_arms["int4"]["peak_concurrent_sessions"]
+    int4_vs_fp = round(i4_peak / fp_peak, 3) if fp_peak else 0.0
+
+    ra, co = arms["ragged"], arms["composed"]
+    return {
+        "workload": {
+            "background_sessions": len(bg_prompts),
+            "background_max_new": bg_max_new,
+            "burst_prompt_tokens": window,
+            "burst_size": burst_size,
+            "burst_every_ticks": burst_every,
+            "bursts": n_bursts,
+            "chunk_tokens": chunk,
+            "max_prefill_slots": 2,
+            "page_size": page_size,
+            "slots": slots,
+        },
+        **{f"{n}_arm": a for n, a in arms.items()},
+        "tokens_per_s_ratio": round(
+            ra["tokens_per_s"] / co["tokens_per_s"], 3)
+        if co["tokens_per_s"] > 0 else 0.0,
+        "inter_token_p95_ratio": round(
+            co["inter_token_p95_s"] / ra["inter_token_p95_s"], 3)
+        if ra["inter_token_p95_s"] > 0 else 0.0,
+        # the structural win the arm exists to record: 1 vs N
+        "programs_per_tick_p50": {
+            "ragged": ra["programs_per_tick"]["p50"],
+            "composed": co["programs_per_tick"]["p50"],
+        },
+        "greedy_tokens_identical": (
+            tokens_by_arm["ragged"] == tokens_by_arm["composed"]),
+        "int4_capacity": {
+            "pool_byte_budget": budget_bytes,
+            "page_bytes": page_bytes,
+            "requests": len(cap_prompts),
+            **{f"{n}_arm": a for n, a in cap_arms.items()},
+            "int8_vs_fp_sessions_ratio": round(i8_peak / fp_peak, 3)
+            if fp_peak else 0.0,
+            "int4_vs_int8_sessions_ratio": round(i4_peak / i8_peak, 3)
+            if i8_peak else 0.0,
+            "int4_vs_fp_sessions_ratio": int4_vs_fp,
+            "meets_1p8x_fp": bool(int4_vs_fp >= 1.8),
+            "quality": {
+                "greedy_token_agreement_vs_fp":
+                    round(matched / total, 4) if total else None,
+                "exact_sequence_match":
+                    round(exact / len(cap_prompts), 4),
+                "compared_tokens": total,
+            },
+        },
+    }
+
+
 def run_baseline(model, params, requests, warmup: bool):
     """Single-request serving: generate() per request, back-to-back, on the
     canonical padded shape (prompt left-padded to the full window)."""
@@ -1570,6 +1822,16 @@ def main(argv=None) -> dict:
                          "--chunked-repeats; the block lands in the "
                          "--profile-out artifact (BENCH_serving.json)")
     ap.add_argument("--chunked-repeats", type=int, default=5)
+    ap.add_argument("--ragged", action="store_true",
+                    help="run the unified-ragged-tick arm: fused one-program "
+                         "tick vs the composed kill-switch arm on a mixed "
+                         "prefill+decode workload (tokens/s, inter-token "
+                         "p95, programs-per-tick 1-vs-N), interleaved "
+                         "median-of --ragged-repeats, plus the int4-page "
+                         "capacity section (sessions at fixed HBM vs "
+                         "int8/fp, greedy agreement); the block lands in "
+                         "the --profile-out artifact (BENCH_serving.json)")
+    ap.add_argument("--ragged-repeats", type=int, default=7)
     ap.add_argument("--replicas", type=int, default=0,
                     help="run the replica-scaling arm: a burst workload through "
                          "a 1-replica vs N-replica ServingRouter (interleaved, "
@@ -1627,6 +1889,12 @@ def main(argv=None) -> dict:
     def chunked_arm(model, config, params):
         block = run_chunked_interference(model, config, params, args.slots,
                                          args.seed, repeats=args.chunked_repeats)
+        block["preset"] = args.preset
+        return block
+
+    def ragged_arm(model, config, params):
+        block = run_ragged_tick_bench(model, config, params, args.slots,
+                                      args.seed, repeats=args.ragged_repeats)
         block["preset"] = args.preset
         return block
 
@@ -1700,6 +1968,8 @@ def main(argv=None) -> dict:
             result["prefix_cache"] = prefix_cache_arm(model, config, profile_params)
         if args.chunked:
             result["chunked_prefill"] = chunked_arm(model, config, profile_params)
+        if args.ragged:
+            result["ragged_tick"] = ragged_arm(model, config, profile_params)
         if args.rolling_restart:
             result["fleet_ops"] = fleet_ops_arm(model, config, profile_params)
         tmp = args.profile_out + ".tmp"
@@ -1774,6 +2044,10 @@ def main(argv=None) -> dict:
         block = chunked_arm(model, config, params)
         result["chunked_prefill"] = block
         merge_section("chunked_prefill", block, result["recorded_at"])
+    if args.ragged:
+        block = ragged_arm(model, config, params)
+        result["ragged_tick"] = block
+        merge_section("ragged_tick", block, result["recorded_at"])
     if args.rolling_restart:
         block = fleet_ops_arm(model, config, params)
         result["fleet_ops"] = block

@@ -59,24 +59,30 @@ def setup():
     return _make_model()
 
 
-def _quant_cache(n_pool, ps, h, d, table, start, window):
+def _quant_cache(n_pool, ps, h, d, table, start, window, qbits=8):
+    # int4 pools pack two codes per byte along channels: uint8, C//2 wide
+    pool_dtype = jnp.uint8 if qbits == 4 else jnp.int8
+    c_phys = (h * d) // 2 if qbits == 4 else h * d
     return pdk.PagedKVCache(
-        kp=jnp.zeros((n_pool, ps, h * d), jnp.int8),
-        vp=jnp.zeros((n_pool, ps, h * d), jnp.int8),
+        kp=jnp.zeros((n_pool, ps, c_phys), pool_dtype),
+        vp=jnp.zeros((n_pool, ps, c_phys), pool_dtype),
         page_table=table, start=start, window=window,
         k_scale=jnp.zeros((n_pool, h), jnp.float32),
         v_scale=jnp.zeros((n_pool, h), jnp.float32),
-        num_heads=h,
+        num_heads=h, qbits=qbits,
     )
 
 
 # ---------------------------------------------------------------- numerics
-def test_per_page_per_head_roundtrip_error_bound():
+@pytest.mark.parametrize("qbits", [8, 4])
+def test_per_page_per_head_roundtrip_error_bound(qbits):
     """Quantize a page, dequantize it: the error of every entry is bounded by
-    half an LSB of ITS page's, ITS head's scale — amax / (2 * 127) — the
-    bound per-page-per-head scoping exists to keep tight (a per-tensor scale
+    half an LSB of ITS page's, ITS head's scale — amax / (2 * qmax), qmax
+    127 for int8 and 7 for nibble-packed int4 — the bound's
+    per-page-per-head scoping exists to keep tight (a per-tensor scale
     would smear one loud head's amax over every quiet one)."""
     n_pool, ps, h, d = 5, 8, 4, 8
+    qmax = 7.0 if qbits == 4 else 127.0
     rng = np.random.RandomState(0)
     # heads at wildly different magnitudes: the per-head bound must hold per
     # head, not merely on the loudest one
@@ -85,15 +91,16 @@ def test_per_page_per_head_roundtrip_error_bound():
     blocks.reshape(3, ps, h, d)[:, :, 2] *= 0.01
     cache = _quant_cache(n_pool, ps, h, d,
                          jnp.asarray([[1, 2, 3]], jnp.int32),
-                         jnp.zeros((1,), jnp.int32), 3 * ps)
+                         jnp.zeros((1,), jnp.int32), 3 * ps, qbits=qbits)
     qc = cache.write_pages(jnp.asarray([1, 2, 3]), jnp.asarray(blocks),
                            jnp.asarray(blocks * 0.5))
-    assert qc.kp.dtype == jnp.int8
+    assert qc.kp.dtype == (jnp.uint8 if qbits == 4 else jnp.int8)
+    assert qc.num_channels == h * d  # logical width survives nibble packing
     k_deq, v_deq = qc.gather_slot(jnp.asarray([1, 2, 3]))
     deq = np.asarray(k_deq)[0].reshape(3, ps, h, d)
     err = np.abs(deq - blocks.reshape(3, ps, h, d)).max(axis=(1, 3))  # (3, h)
     amax = np.abs(blocks.reshape(3, ps, h, d)).max(axis=(1, 3))
-    bound = amax / (2 * 127.0) * (1 + 1e-5) + 1e-8
+    bound = amax / (2 * qmax) * (1 + 1e-5) + 1e-8
     assert (err <= bound).all(), (err, bound)
     # v pool honors its own scales (amax halved -> bound halved)
     deq_v = np.asarray(v_deq)[0].reshape(3, ps, h, d)
@@ -128,6 +135,37 @@ def test_append_ratchet_is_saturating_and_zeroes_fresh_pages():
     got = np.asarray(k_deq)[0][:2]
     assert np.allclose(got[0], 0.5, atol=5.0 / 254 + 1e-6)
     assert np.allclose(got[1], 5.0, atol=5.0 / 254 + 1e-6)
+
+
+def test_append_ratchet_int4_zeroes_fresh_pages_and_requantizes():
+    """The int4 form of the ratchet contract: a fresh page's stale PACKED
+    bytes are zeroed by the first write (byte 0 == code -8 paired with
+    scale 0 == exact 0.0), and a louder later row requantizes earlier rows
+    by the scale ratio within the int4 half-LSB bound."""
+    n_pool, ps, h, d = 4, 4, 2, 4
+    cache = _quant_cache(n_pool, ps, h, d, jnp.asarray([[1, 2, 3]], jnp.int32),
+                         jnp.zeros((1,), jnp.int32), 12, qbits=4)
+    cache = cache.replace(
+        kp=cache.kp.at[1].set(0x77), vp=cache.vp.at[1].set(0x55),
+    )
+    row0 = np.full((1, 1, h * d), 0.5, np.float32)
+    c1 = cache.append_token(jnp.asarray(row0), jnp.asarray(row0))
+    kp = np.asarray(c1.kp)
+    # written row: code +7 in both nibbles -> (7+8) | ((7+8)<<4) = 0xFF
+    assert (kp[1, 0] == 0xFF).all()
+    # stale tenant nibbles collapse to packed code -8|-8 == byte 0, which
+    # dequantizes to -8 * (ratio 0 requantize) = exact 0 rows
+    k_deq, v_deq = c1.gather_slot(jnp.asarray([1, 2, 3]))
+    assert (np.asarray(k_deq)[0, 1:ps] == 0).all()
+    assert (np.asarray(v_deq)[0, 1:ps] == 0).all()
+    # 10x louder second row ratchets the scale; both rows stay within the
+    # int4 bound of THEIR magnitude (no clipping of the quiet row)
+    row1 = np.full((1, 1, h * d), 5.0, np.float32)
+    c2 = c1.append_token(jnp.asarray(row1), jnp.asarray(row1))
+    k2, _ = c2.gather_slot(jnp.asarray([1, 2, 3]))
+    got = np.asarray(k2)[0][:2]
+    assert np.allclose(got[0], 0.5, atol=5.0 / 14 + 1e-6)
+    assert np.allclose(got[1], 5.0, atol=5.0 / 14 + 1e-6)
 
 
 def _quantized_kernel_inputs(window, ps, seed=0):
@@ -417,12 +455,14 @@ def test_quant_preempt_resume_token_identity(setup):
 
 
 # ------------------------------------------------------------- containment
-def test_quant_quarantine_zeroes_bytes_and_scales(setup):
+@pytest.mark.parametrize("kv_quant", ["int8", "int4"])
+def test_quant_quarantine_zeroes_bytes_and_scales(setup, kv_quant):
     """Containment on a quantized pool: the condemned slot's pages have
-    their int8 bytes AND scale sidecars zeroed before returning to the free
-    list, and the survivor decodes on bit-identical."""
+    their code bytes (int8, or int4 nibble-packed) AND scale sidecars
+    zeroed before returning to the free list, and the survivor decodes on
+    bit-identical."""
     model, params = setup
-    kw = dict(num_slots=2, kv_page_size=PS, kv_quant="int8")
+    kw = dict(num_slots=2, kv_page_size=PS, kv_quant=kv_quant)
     ref_engine = ServingEngine(model, params, **kw)
     ref = ref_engine.submit([4, 5, 6], max_new_tokens=5)
     ref_engine.run_until_drained(max_steps=100)
@@ -509,7 +549,7 @@ def test_constructor_validation(setup):
     with pytest.raises(ValueError, match="requires kv_page_size"):
         ServingEngine(model, params, num_slots=2, kv_quant="int8")
     with pytest.raises(ValueError, match="kv_quant must be one of"):
-        ServingEngine(model, params, num_slots=2, kv_page_size=PS, kv_quant="int4")
+        ServingEngine(model, params, num_slots=2, kv_page_size=PS, kv_quant="int2")
     with pytest.raises(ValueError, match="weight_dtype must be one of"):
         ServingEngine(model, params, num_slots=2, weight_dtype="fp4")
     with pytest.raises(ValueError, match="multiple of kv_page_size"):
@@ -534,7 +574,7 @@ def test_metrics_v9_sections_and_reader_backcompat(setup, tmp_path):
     from perceiver_io_tpu.serving import load_metrics_jsonl
     from perceiver_io_tpu.serving.metrics import SCHEMA
 
-    assert SCHEMA == "serving-metrics/v10"
+    assert SCHEMA == "serving-metrics/v11"
     model, params = setup
     path = tmp_path / "v9.jsonl"
     engine = ServingEngine(model, params, num_slots=2, kv_page_size=PS,
@@ -546,7 +586,7 @@ def test_metrics_v9_sections_and_reader_backcompat(setup, tmp_path):
     engine.metrics.record_quant_agreement(5, 6)
     snap = engine.metrics.write_snapshot()
     engine.close()
-    assert snap["schema"] == "serving-metrics/v10"
+    assert snap["schema"] == "serving-metrics/v11"
     kvq = snap["kv_quant"]
     assert kvq["mode"] == "int8"
     assert kvq["bytes_per_token"] < kvq["bytes_per_token_fp"]
