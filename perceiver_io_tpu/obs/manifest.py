@@ -25,7 +25,7 @@ MANIFEST_SCHEMA = "run-manifest/v1"
 
 # every artifact schema the repo currently writes, in one place
 ARTIFACT_SCHEMAS = {
-    "serving_metrics": "serving-metrics/v11",
+    "serving_metrics": "serving-metrics/v12",
     "train_metrics": "train-metrics/v1",
     "chrome_trace": "chrome-trace/v1",
     "request_journal": "request-journal/v1",

@@ -48,6 +48,23 @@ tests and ``scripts/chaos_check.py`` arm:
                              must dedupe it to exactly once (the
                              ``migrate_crash_midflight`` chaos scenario turns
                              this into a real child-process SIGKILL)
+  ``transport.send.torn``    corrupt the CRC of one outgoing RPC frame to a
+                             worker-process replica (``slot`` selects the
+                             replica; a torn/bit-rotted frame on the wire) —
+                             the worker NACKs it and the client's retry
+                             policy resends (serving/transport.py)
+  ``transport.recv.timeout`` the client treats one RPC reply as timed out
+                             (``slot`` selects the replica) without reading
+                             it — the retry resends and the at-most-once seq
+                             dedup absorbs the duplicate
+  ``transport.worker.kill``  SIGKILL the worker process behind a replica
+                             (``slot`` selects it) right before an RPC — a
+                             real OS-level process death; the supervisor
+                             respawns it through journal recovery
+  ``transport.worker.hang``  SIGSTOP the worker process (``slot`` selects
+                             it) — a wedged-but-alive worker; every RPC times
+                             out until the retry budget exhausts and the
+                             breaker takes the strike
 
 Arming: ``FAULTS.arm(point, after=..., times=..., value=..., slot=...)`` in
 process, or the env ``PERCEIVER_IO_TPU_FAULT="point:key=val,key=val;point2"``
@@ -89,6 +106,10 @@ POINTS = frozenset(
         "serving.journal.corrupt_record",
         "serving.journal.compact.kill",
         "router.migrate.kill",
+        "transport.send.torn",
+        "transport.recv.timeout",
+        "transport.worker.kill",
+        "transport.worker.hang",
     }
 )
 
@@ -328,6 +349,40 @@ def fire_migrate_kill() -> None:
             f"injected kill mid-migration (firing {spec.fired}"
             f"{'' if spec.times is None else f'/{spec.times}'})"
         )
+
+
+def fire_transport_send_torn(replica_id: Optional[int] = None) -> bool:
+    """Client-side RPC framing hook (serving/transport.py ``_send_frame``):
+    True when this outgoing frame's CRC must be corrupted on the wire. The
+    frame stays well-FORMED (magic + length intact) so the worker reads it
+    whole, rejects the checksum, and NACKs — the torn-frame path the
+    ``transport_torn_frame`` chaos scenario pins."""
+    return FAULTS.fire("transport.send.torn", target=replica_id) is not None
+
+
+def fire_transport_recv_timeout(replica_id: Optional[int] = None) -> bool:
+    """Client-side RPC receive hook: True when this reply read must be
+    treated as timed out WITHOUT consuming the reply (the worker may well
+    have executed and answered — exactly a network timeout's ambiguity).
+    The retry resends under the same seq; the worker's cached-reply dedup
+    makes the duplicate harmless."""
+    return FAULTS.fire("transport.recv.timeout", target=replica_id) is not None
+
+
+def fire_transport_worker_kill(replica_id: Optional[int] = None) -> Optional[FaultSpec]:
+    """Client-side pre-RPC hook: when armed, the caller SIGKILLs its worker
+    process — a REAL kill -9, not a simulation (the transport must then see
+    EPIPE/EOF and surface ``WorkerDiedError``). Returns the spec so the
+    caller owns the signal; the registry never holds a pid."""
+    return FAULTS.fire("transport.worker.kill", target=replica_id)
+
+
+def fire_transport_worker_hang(replica_id: Optional[int] = None) -> Optional[FaultSpec]:
+    """Client-side pre-RPC hook: when armed, the caller SIGSTOPs its worker
+    process — alive but wedged, the failure mode timeouts exist for. The
+    RPC (and its retries) must time out, exhaust the policy, and strike the
+    breaker."""
+    return FAULTS.fire("transport.worker.hang", target=replica_id)
 
 
 def fire_checkpoint_write(path: str) -> None:

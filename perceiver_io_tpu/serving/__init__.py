@@ -8,8 +8,11 @@ deterministic failover, and SLO-aware shedding (docs/reliability.md).
 ``RouterMetrics`` the observability surface, and ``RequestJournal`` the
 crash-durability layer (write-ahead accept/token/terminal records;
 ``ServingEngine.recover`` / ``ServingRouter.recover`` rebuild every accepted
-session after process death). ``scripts/serve_bench.py`` drives synthetic
-workloads through all of it.
+session after process death). ``EngineClient`` puts one replica engine in a
+separate OS PROCESS behind a CRC-framed, retrying RPC transport
+(``ServingRouter(replica_mode="process")`` — a supervisor respawns killed
+workers through journal recovery). ``scripts/serve_bench.py`` drives
+synthetic workloads through all of it.
 """
 
 from perceiver_io_tpu.serving.engine import (
@@ -55,9 +58,23 @@ from perceiver_io_tpu.serving.router import (
     fleet_ops_enabled,
 )
 from perceiver_io_tpu.serving.scheduler import SlotScheduler, preemption_enabled
+from perceiver_io_tpu.serving.transport import (
+    EngineClient,
+    FrameError,
+    TransportError,
+    WorkerDiedError,
+    WorkerOpError,
+    proc_replicas_enabled,
+)
 
 __all__ = [
+    "EngineClient",
     "EngineMetrics",
+    "FrameError",
+    "TransportError",
+    "WorkerDiedError",
+    "WorkerOpError",
+    "proc_replicas_enabled",
     "JournalCorruptError",
     "JournalSession",
     "JournalTornWrite",

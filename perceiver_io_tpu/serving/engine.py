@@ -305,6 +305,12 @@ class ServedRequest:
     # and a continuation is in-flight work whichever replica it lands on —
     # and _begin_drain keeps it queued the way PREEMPTED continuations are
     is_resume: bool = False
+    # router param-version pin, journaled on the accept record so a rollout
+    # pin survives process death (the per-replica param-version manifest,
+    # docs/serving.md "Fleet operations"). Opaque to the engine itself —
+    # the ROUTER chooses which weights serve which replica; this field only
+    # rides the durability path. None on engine-only callers.
+    version: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -1354,6 +1360,7 @@ class ServingEngine:
         priority: int = 0,
         resume: bool = False,
         session_id: Optional[str] = None,
+        version: Optional[int] = None,
         **kwargs,
     ) -> ServedRequest:
         """Queue one request; returns its handle. ``config``/kwargs follow
@@ -1374,7 +1381,9 @@ class ServingEngine:
         finishes under drain, whichever replica it lands on — while every
         other admission rule (queue bound, prompt length) applies unchanged.
         ``session_id`` is the router's fleet-unique identity, journaled on
-        the accept record for cross-journal recovery dedup.
+        the accept record for cross-journal recovery dedup. ``version`` is
+        the router's param-version pin, journaled alongside it (the manifest
+        entry a recovery rebuilds the session against) — opaque here.
 
         MALFORMED requests (empty prompt, unservable config) raise ValueError
         — they are caller bugs. WELL-FORMED requests the pool cannot serve
@@ -1414,6 +1423,7 @@ class ServingEngine:
             if replay_ids is not None and len(replay_ids) else None,
             session_id=session_id,
             is_resume=bool(resume),
+            version=None if version is None else int(version),
         )
         if request.deadline_s is not None:
             self._deadlines_seen = True
@@ -1475,6 +1485,7 @@ class ServingEngine:
                     replay=request.replay_ids.tolist()
                     if request.replay_ids is not None else None,
                     session_id=request.session_id,
+                    version=request.version,
                 )
             except BaseException:
                 # durability cannot be promised, so the accept must not
@@ -1954,6 +1965,16 @@ class ServingEngine:
                                 new_tokens=len(request.output_ids))
         return request
 
+    def mark_resume(self, request_id: int) -> None:
+        """Flag a live request as a failover/migration continuation. The
+        router sets this on adopted handles so ``_begin_drain``'s queue prune
+        keeps them (accepted-elsewhere work is never backlog); it is a method
+        rather than a bare attribute write so the flag crosses the
+        out-of-process replica boundary (serving/transport.py) too."""
+        request = self._requests.get(request_id)
+        if request is not None:
+            request.is_resume = True
+
     # -------------------------------------------------------------- preemption
     def _select_victims(self, request: ServedRequest) -> List:
         """The cheapest set of strictly-lower-class running slots whose
@@ -2232,6 +2253,7 @@ class ServingEngine:
                     replay_ids=emitted if emitted else None,
                     priority=session.priority,
                     session_id=session.session,
+                    version=session.version,
                 )
                 if handle.status is RequestStatus.REJECTED:  # defensive: it fit once
                     raise JournalCorruptError(
@@ -2260,6 +2282,7 @@ class ServingEngine:
                     priority=session.priority, deadline_s=handle.deadline_s,
                     accepted_ts=now, admitted=session.admitted,
                     replay=emitted, tokens=[], session=session.session,
+                    version=session.version,
                 )))
         finally:
             self.max_queue_depth = saved_bound

@@ -176,6 +176,13 @@ class JournalSession:
     # exactly ONCE (ServingRouter.recover dedupes on it). None on engine-only
     # journals and on pre-fleet records: dedup simply never applies there.
     session: Optional[str] = None
+    # the param version this session's accept was pinned to (docs/serving.md
+    # "Fleet operations" — the per-replica param-version manifest): rollout
+    # pins must survive process death, so the pin rides the accept record and
+    # recovery rebuilds the session against the SAME weights, failing loudly
+    # when the pinned version is no longer deployable. None on engine-only
+    # journals, single-version fleets, and every pre-manifest record.
+    version: Optional[int] = None
 
     @property
     def emitted(self) -> List[int]:
@@ -260,6 +267,7 @@ def read_journal(path: str) -> JournalState:
                     admitted=bool(record.get("admitted", False)),
                     replay=list(record.get("replay") or []),
                     session=record.get("session"),
+                    version=record.get("version"),
                 )
                 order.append(rid)
             elif kind == "tick":
@@ -459,14 +467,17 @@ class RequestJournal:
                       deadline_s: Optional[float] = None,
                       replay: Optional[Sequence[int]] = None,
                       admitted: bool = False,
-                      session_id: Optional[str] = None) -> None:
+                      session_id: Optional[str] = None,
+                      version: Optional[int] = None) -> None:
         """The durability point of ``submit()``: once this returns, the
         request survives process death. Fsynced under the default policy —
         accepted ⇒ durable is the contract, and accepts are per-request (not
         per-token), so the fsync cost scales with admission rate, not decode
         rate. ``session_id`` is the router's fleet-unique identity for
         cross-journal dedup (JournalSession.session); None for engine-only
-        journals."""
+        journals. ``version`` is the router's param-version pin for this
+        session (the manifest entry recovery rebuilds the session against);
+        None keeps the record byte-identical to pre-manifest journals."""
         if self._closed:
             raise JournalCorruptError(f"journal {self.path} is closed")
         session = JournalSession(
@@ -474,7 +485,7 @@ class RequestJournal:
             rng=[int(x) for x in rng], priority=int(priority),
             deadline_s=deadline_s, accepted_ts=time.time(),
             admitted=admitted, replay=[int(t) for t in (replay or [])],
-            session=session_id,
+            session=session_id, version=None if version is None else int(version),
         )
         record = {
             "type": "accept", "rid": rid, "prompt": session.prompt,
@@ -489,6 +500,8 @@ class RequestJournal:
             record["admitted"] = True
         if session.session is not None:
             record["session"] = session.session
+        if session.version is not None:
+            record["version"] = session.version
         self._append(record)
         if self.fsync in ("accept", "always"):
             self._sync()
@@ -591,6 +604,8 @@ class RequestJournal:
                     record["admitted"] = True
                 if session.session is not None:
                     record["session"] = session.session
+                if session.version is not None:
+                    record["version"] = session.version
                 records.append(record)
             for record in records:
                 line = encode_record(record) + "\n"
@@ -621,7 +636,7 @@ class RequestJournal:
                 rng=session.rng, priority=session.priority,
                 deadline_s=session.deadline_s, accepted_ts=session.accepted_ts,
                 admitted=session.admitted, replay=session.emitted, tokens=[],
-                session=session.session,
+                session=session.session, version=session.version,
             )
             for rid, session in sessions
         }

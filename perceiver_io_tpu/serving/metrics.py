@@ -135,6 +135,18 @@ Schema history:
     lane packing; 0 when composed). The stream is unchanged — the block is
     windowed gauges only. The reader normalizes pre-v11 snapshots with
     ``None``.
+  * ``serving-metrics/v12`` — the out-of-process-replica schema
+    (docs/serving.md "Out-of-process replicas"): every snapshot carries a
+    ``transport`` field — ``None`` on plain engines and on in-process
+    routers (no RPC boundary exists), else the fleet-aggregated gauges
+    ``rpcs`` / ``retries`` / ``timeouts`` (recv timeouts observed) /
+    ``frames_sent`` / ``frames_recv`` / ``bytes_sent`` / ``bytes_recv`` /
+    ``workers_alive`` / ``rpc_p50_ms`` / ``rpc_p95_ms`` (pooled over the
+    latency window) / ``worker_respawns`` (dead worker processes the
+    supervisor respawned through journal recovery). The stream gains
+    ``respawn`` events (one per supervisor respawn) and ``rpc_retry``
+    events (one per transport retry, with op/attempt/error/delay). The
+    reader normalizes pre-v12 snapshots with ``None``.
 """
 
 from __future__ import annotations
@@ -147,7 +159,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-SCHEMA = "serving-metrics/v11"
+SCHEMA = "serving-metrics/v12"
 KNOWN_SCHEMAS = (
     "serving-metrics/v1",
     "serving-metrics/v2",
@@ -160,6 +172,7 @@ KNOWN_SCHEMAS = (
     "serving-metrics/v9",
     "serving-metrics/v10",
     "serving-metrics/v11",
+    "serving-metrics/v12",
 )
 _V3_COUNTERS = ("rejected", "timed_out", "failed")
 _V4_FIELDS = ("failovers", "shed_infeasible", "breaker_transitions")
@@ -173,6 +186,7 @@ _PRE_V8 = KNOWN_SCHEMAS[:7]
 _PRE_V9 = KNOWN_SCHEMAS[:8]
 _PRE_V10 = KNOWN_SCHEMAS[:9]
 _PRE_V11 = KNOWN_SCHEMAS[:10]
+_PRE_V12 = KNOWN_SCHEMAS[:11]
 
 _PERCENTILE_KEYS = ("p50", "p95")
 
@@ -276,6 +290,11 @@ def load_metrics_jsonl(path: str) -> Dict:
                 # pre-v11 writers had no unified ragged tick; None also
                 # matches a newer DENSE engine's truthful "no tick dispatcher"
                 snap.setdefault("ragged_tick", None)
+            if schema in _PRE_V12:
+                # pre-v12 writers had no out-of-process transport; None also
+                # matches a newer in-process fleet's truthful "no RPC
+                # boundary exists"
+                snap.setdefault("transport", None)
             snapshots.append(snap)
     return {"events": events, "snapshots": snapshots}
 
@@ -730,6 +749,10 @@ class EngineMetrics(_JsonlMetrics):
             # autoscale) is a ROUTER behavior — a plain engine truthfully
             # has none (same reading as a pre-v10 snapshot)
             "fleet_ops": None,
+            # v12: the RPC transport is a ROUTER/client behavior — a plain
+            # engine truthfully has no process boundary (same reading as a
+            # pre-v12 snapshot)
+            "transport": None,
             # v11: None on dense engines (no tick dispatcher exists — same
             # reading as a pre-v11 snapshot); on paged engines the per-tick
             # program/work gauges, whichever dispatcher is live
@@ -815,6 +838,13 @@ class RouterMetrics(_JsonlMetrics):
     # report rollout: None — the feature-off reading)
     versions: Dict[str, Dict[str, int]] = field(default_factory=dict)
     rollout_state: Optional[Dict] = None  # {primary_version, rollout_version, fraction}
+    # out-of-process transport counters (serving-metrics/v12, docs/serving.md
+    # "Out-of-process replicas"): supervisor respawns and transport retries
+    # are lifetime totals here; the windowed RPC gauges arrive per tick via
+    # set_transport (None in-process — no RPC boundary exists)
+    worker_respawns: int = 0
+    rpc_retries: int = 0
+    transport_state: Optional[Dict] = None
     _start_time: Optional[float] = None
     _jsonl_file: Optional[object] = field(default=None, repr=False)
     _closed: bool = field(default=False, repr=False)
@@ -914,6 +944,28 @@ class RouterMetrics(_JsonlMetrics):
         self._emit("autoscale", direction=direction, replica=replica,
                    active=active, load=load, tick=tick)
 
+    def record_respawn(self, replica: int, sessions: int, tick: int) -> None:
+        """One supervisor worker respawn (serving-metrics/v12): the
+        replica's dead worker PROCESS was replaced and re-attached through
+        its own journal recovery — ``sessions`` live sessions came back,
+        f64 token-identical, with no breaker strike and no failover spent."""
+        self.worker_respawns += 1
+        self._emit("respawn", replica=replica, sessions=sessions, tick=tick)
+
+    def record_rpc_retry(self, replica: int, op: str, attempt: int,
+                         err: str, delay: float) -> None:
+        """One transport-level RPC retry (serving-metrics/v12): attempt
+        ``attempt`` of ``op`` on ``replica`` failed with ``err`` and the
+        deterministic backoff schedule sleeps ``delay`` before the next."""
+        self.rpc_retries += 1
+        self._emit("rpc_retry", replica=replica, op=op, attempt=attempt,
+                   err=err, delay_s=round(float(delay), 6))
+
+    def set_transport(self, stats: Optional[Dict]) -> None:
+        """Refresh the v12 transport gauges (the router aggregates its
+        EngineClients' counters per snapshot; None in-process)."""
+        self.transport_state = stats
+
     def set_fleet_gauges(self, replicas_active: int,
                          restart_in_progress: bool,
                          primary_version: Optional[int] = None) -> None:
@@ -1005,6 +1057,14 @@ class RouterMetrics(_JsonlMetrics):
                                  for v, row in sorted(self.versions.items(),
                                                       key=lambda kv: int(kv[0]))},
                 },
+            },
+            # v12: the fleet-aggregated RPC transport gauges — None on
+            # in-process fleets (no RPC boundary exists, the pre-v12
+            # reading); the lifetime respawn/retry totals ride the block
+            "transport": None if self.transport_state is None else {
+                **self.transport_state,
+                "worker_respawns": self.worker_respawns,
+                "rpc_retries": self.rpc_retries,
             },
             "tokens_generated": tokens,
             "wall_seconds": round(wall, 6),

@@ -150,9 +150,21 @@ from perceiver_io_tpu.serving.engine import (
     ServedRequest,
     ServingEngine,
     _engine_compatible,
+    _journal_config_payload,
+)
+from perceiver_io_tpu.serving.journal import (
+    JournalSession,
+    RequestJournal,
+    journal_enabled,
+    read_journal,
 )
 from perceiver_io_tpu.serving.metrics import RouterMetrics
 from perceiver_io_tpu.serving.quant import tree_layout_mismatch
+from perceiver_io_tpu.serving.transport import (
+    EngineClient,
+    WorkerDiedError,
+    proc_replicas_enabled,
+)
 
 # breaker states (str values land in metrics transition keys and trace events)
 BREAKER_CLOSED = "closed"
@@ -229,6 +241,13 @@ class RoutedRequest:
     # accept both live) or lose a parked continuation whose origin entry was
     # closed too early (serving/journal.py; docs/serving.md).
     _journal_origin: Optional[tuple] = field(default=None, repr=False)
+    # True while the ROUTER's accept journal holds this request live: a fresh
+    # submit parked during a full-fleet outage is journaled at the router
+    # level (the previously documented memory-only durability hole), and the
+    # entry closes when the request either lands on an engine (whose own
+    # accept record takes over as the durable anchor) or resolves terminally
+    # while parked (docs/serving.md "Out-of-process replicas").
+    _router_journaled: bool = field(default=False, repr=False)
 
     @property
     def status(self) -> RequestStatus:
@@ -382,6 +401,17 @@ class ServingRouter:
         # depth + per-replica queue-beyond-capacity), acting only after
         # ``patience`` consecutive over/under readings.
         autoscale: Optional[Dict] = None,
+        # out-of-process replicas (docs/serving.md "Out-of-process
+        # replicas"): "process" spawns each replica as a separate OS worker
+        # behind serving/transport.py's framed RPC — same dispatch, breaker,
+        # failover, and journal semantics across a boundary kill -9 can
+        # sever. "inproc" (default) keeps today's in-interpreter engines,
+        # byte-identical; PERCEIVER_IO_TPU_DISABLE_PROC_REPLICAS=1 forces it
+        # even when the knob says "process".
+        replica_mode: str = "inproc",
+        # transport knob bundle forwarded to every EngineClient in process
+        # mode (rpc_timeout_s / init_timeout_s / retry); ignored in-process
+        transport: Optional[Dict] = None,
         # internal: recover() constructs the fleet journal-less, replays each
         # replica's journal, THEN attaches — never pass this yourself
         _from_recovery: bool = False,
@@ -412,6 +442,25 @@ class ServingRouter:
                 f"with num_replicas > 1, got {journal!r}"
             )
         self._journal_template = journal
+        if replica_mode not in ("inproc", "process"):
+            raise ValueError(
+                f"replica_mode must be 'inproc' or 'process', got {replica_mode!r}"
+            )
+        self._replica_mode = ("process" if replica_mode == "process"
+                              and proc_replicas_enabled() else "inproc")
+        self._transport_cfg = dict(transport or {})
+        # router-level accept journal (the closed fleet durability boundary):
+        # fresh submits that park because NO replica can accept are journaled
+        # here, so a full-fleet outage no longer loses them — recover()
+        # replays this directory back into _pending. Sited beside the
+        # replica journals under the same template.
+        self._router_journal: Optional[RequestJournal] = None
+        self._router_journal_dir: Optional[str] = None
+        if journal is not None:
+            self._router_journal_dir = (
+                journal.format(i="router") if "{i}" in journal
+                else journal + "-router"
+            )
         # cooldown ladder: reliability/retry.py's bounded-exponential schedule
         # in TICK units with jitter 0 — cooldown(nth consecutive open) =
         # min(max, base * 2^(n-1)) ticks. Deterministic: the rng argument is
@@ -509,6 +558,12 @@ class ServingRouter:
                 )
         self._scale_up_streak = 0
         self._scale_down_streak = 0
+        # constructed only after every knob validated — a rejected
+        # constructor must not leave a journal directory behind (a later
+        # construction would refuse to attach to the non-empty leftover)
+        if (self._router_journal_dir is not None and not _from_recovery
+                and journal_enabled()):
+            self._router_journal = RequestJournal(self._router_journal_dir)
         self.replicas: List[_Replica] = [
             _Replica(rid=i, engine=self._make_engine(
                 i,
@@ -544,17 +599,40 @@ class ServingRouter:
         """One replica engine at the fleet's configured geometry, serving
         ``version``'s params (the primary version by default) — the single
         construction point initial build, recycle, revive, and scale-up all
-        share, so a rebuilt replica can never drift from the fleet's knobs."""
+        share, so a rebuilt replica can never drift from the fleet's knobs.
+        In process mode the same construction point returns an
+        ``EngineClient`` — a worker process behind the framed RPC exposing
+        the identical engine surface (serving/transport.py)."""
         version = self._primary_version if version is None else version
+        metrics_jsonl = (self._replica_metrics_jsonl.format(i=rid)
+                         if self._replica_metrics_jsonl else None)
+        if self._replica_mode == "process":
+            return EngineClient(
+                self.model, self._versions[version],
+                replica_id=rid,
+                metrics_jsonl=metrics_jsonl,
+                journal=journal_path,
+                on_retry=self._note_rpc_retry,
+                **self._transport_cfg,
+                **self._engine_cfg,
+            )
         return ServingEngine(
             self.model, self._versions[version],
-            metrics_jsonl=self._replica_metrics_jsonl.format(i=rid)
-            if self._replica_metrics_jsonl else None,
+            metrics_jsonl=metrics_jsonl,
             journal=journal_path,
             telemetry=self._obs if self._obs_on else False,
             obs_ns=f"serving.r{rid}",
             **self._engine_cfg,
         )
+
+    def _note_rpc_retry(self, replica: int, op: str, attempt: int,
+                        err: str, delay: float) -> None:
+        """EngineClient's on_retry hook: every transport retry lands in the
+        metrics stream as an ``rpc_retry`` event (serving-metrics/v12).
+        Guarded: the init RPC fires before ``self.metrics`` exists."""
+        metrics = getattr(self, "metrics", None)
+        if metrics is not None:
+            metrics.record_rpc_retry(replica, op, attempt, err, delay)
 
     def _active_replicas(self) -> List[_Replica]:
         """Every non-retired replica (recycling ones included — they are
@@ -565,6 +643,7 @@ class ServingRouter:
     @classmethod
     def recover(cls, model, params, journal: str, num_replicas: int = 2,
                 fsync: str = "accept", segment_max_records: int = 4096,
+                versions: Optional[Dict[int, object]] = None,
                 **router_kwargs):
         """Rebuild a router fleet from per-replica write-ahead journals after
         process death (docs/serving.md "Request journal"). ``journal`` is
@@ -615,6 +694,16 @@ class ServingRouter:
                      journal=journal, _from_recovery=True, **router_kwargs)
         router._journal_fsync = fsync
         router._journal_segment_max = segment_max_records
+        # the param-version manifest (docs/serving.md "Fleet operations"):
+        # ``params`` is version 0 (the primary); ``versions`` registers the
+        # non-primary trees the dead fleet had deployed, keyed by the SAME
+        # version numbers its accept records pinned. Journaled pins are then
+        # honored below — a session recovered against different weights
+        # than the ones that decoded its prefix would silently diverge.
+        if versions:
+            for v, tree in sorted(versions.items()):
+                router._versions[int(v)] = tree
+            router._next_version = max(router._versions) + 1
         # cross-journal session dedup (docs/serving.md "Fleet operations"):
         # a planned migration has ONE window — after the destination's
         # fsynced accept, before the origin's close record — where the same
@@ -650,6 +739,32 @@ class ServingRouter:
         for r in router.replicas:
             skip = frozenset(sid for sid in per_journal_ids[r.rid]
                              if best[sid][0] != r.rid)
+            # honor the journaled version pins (the manifest): every live
+            # session a replica keeps was accepted while IT served the
+            # pinned version — dispatch and migration enforce that — so the
+            # kept pins must agree; mixed pins mean a corrupt manifest or a
+            # placement no real fleet produces, and recovering them under
+            # any single tree would silently mis-decode some of them.
+            pins = {s.version for s in states[r.rid].sessions
+                    if s.version is not None and s.session not in skip}
+            if len(pins) > 1:
+                raise ValueError(
+                    f"replica {r.rid} journal holds sessions pinned to "
+                    f"multiple param versions {sorted(pins)} — corrupt "
+                    f"version manifest (one replica serves one version)"
+                )
+            pin = pins.pop() if pins else router._primary_version
+            if pin not in router._versions:
+                raise ValueError(
+                    f"replica {r.rid} journal pins its sessions to param "
+                    f"version {pin}, which is no longer deployable — pass "
+                    f"its tree via versions={{{pin}: params_v{pin}}} (the "
+                    f"accept-record manifest refuses to rebuild a session "
+                    f"against different weights than decoded its prefix)"
+                )
+            if pin != r.version:
+                r.engine.set_params(router._versions[pin])
+                r.version = r.target_version = pin
             info = r.engine._recover_attach(
                 journal.format(i=r.rid), fsync=fsync,
                 segment_max_records=segment_max_records,
@@ -664,11 +779,12 @@ class ServingRouter:
                     priority=handle.priority,
                     submitted_at=now,
                     deadline_s=handle.deadline_s,
-                    # version pins do NOT survive process death: the journal
-                    # records requests, not weights, so every recovered
-                    # session re-pins the params handed to recover() — the
-                    # same contract as engine geometry kwargs
-                    version=router._primary_version,
+                    # the journaled pin survives process death (the accept
+                    # record carries it — the param-version manifest); a
+                    # pre-manifest record pins the replica's resolved
+                    # version, which the consensus check above set
+                    version=(handle.version if handle.version is not None
+                             else r.version),
                     session_id=handle.session_id,
                 )
                 routed._engine_handle = handle
@@ -690,6 +806,85 @@ class ServingRouter:
                                             prompt_len=int(handle.prompt_ids.size))
                 handles.append(routed)
             per_replica[f"r{r.rid}"] = info
+        # replay the ROUTER's accept journal (the closed full-outage
+        # durability boundary): fresh submits that were parked — no healthy
+        # replica could accept — when the whole fleet died never reached any
+        # replica journal, so their only durable copy is here. Re-admit each
+        # one to the parked queue; the first healthy tick dispatches them.
+        # A parking entry whose session id also appears in a replica journal
+        # is the OTHER half of the dispatch race: the engine accept landed
+        # but the close record died with the process — the replica copy
+        # (recovered above) is the session, the parking entry is stale.
+        parked_handles: List[RoutedRequest] = []
+        rj_dir = router._router_journal_dir
+        if rj_dir is not None and journal_enabled():
+            if os.path.isdir(rj_dir):
+                rj_state = read_journal(rj_dir)
+                dispatched = set().union(*per_journal_ids.values()) \
+                    if per_journal_ids else set()
+                mirror: List[tuple] = []
+                now_wall = time.time()
+                for s in rj_state.sessions:
+                    if s.session is not None and s.session in dispatched:
+                        continue
+                    pin = (s.version if s.version is not None
+                           else router._primary_version)
+                    if pin not in router._versions:
+                        raise ValueError(
+                            f"router journal holds a parked admission pinned "
+                            f"to param version {pin}, which is no longer "
+                            f"deployable — pass its tree via versions="
+                            f"{{{pin}: ...}}"
+                        )
+                    routed = RoutedRequest(
+                        request_id=next(router._ids),
+                        prompt_ids=np.asarray(s.prompt, np.int32),
+                        config=GenerationConfig(**s.config),
+                        rng=np.asarray(s.rng, np.uint32),
+                        priority=s.priority,
+                        submitted_at=now,
+                        # deadlines keep counting through the outage — the
+                        # journal discipline; an expired parked request dies
+                        # of TTL at the first tick, never resurrects stale
+                        deadline_s=s.remaining_deadline(now_wall),
+                        version=pin,
+                        session_id=s.session,
+                    )
+                    routed._router_journaled = True
+                    if routed.deadline_s is not None:
+                        router._deadlines_seen = True
+                    router.metrics.record_submit(
+                        routed.request_id, int(routed.prompt_ids.size),
+                        priority=routed.priority,
+                        version=pin if router._fleet_ops else None,
+                    )
+                    if router._obs_on:
+                        router._obs.async_begin(
+                            "router.request", routed.request_id,
+                            prompt_len=int(routed.prompt_ids.size))
+                    router._pending.append(routed)
+                    parked_handles.append(routed)
+                    mirror.append((routed.request_id, JournalSession(
+                        rid=routed.request_id, prompt=list(s.prompt),
+                        config=dict(s.config), rng=list(s.rng),
+                        priority=s.priority, deadline_s=routed.deadline_s,
+                        accepted_ts=now_wall, session=s.session,
+                        version=s.version,
+                    )))
+                # generation swap, the journal recovery discipline: the new
+                # generation holds exactly the re-admitted entries under
+                # their new router ids; the old one stays durable until the
+                # rename lands
+                router._router_journal = RequestJournal(
+                    rj_dir, fsync=fsync,
+                    segment_max_records=segment_max_records,
+                    _recovered_from=rj_state, _sessions=mirror,
+                )
+            else:
+                router._router_journal = RequestJournal(
+                    rj_dir, fsync=fsync,
+                    segment_max_records=segment_max_records,
+                )
         return router, {
             "sessions": len(handles),
             "replayed_tokens": sum(i["replayed_tokens"]
@@ -697,6 +892,8 @@ class ServingRouter:
             "deduped": sum(i["deduped"] for i in per_replica.values()),
             "replicas": per_replica,
             "handles": handles,
+            "router_parked": len(parked_handles),
+            "parked_handles": parked_handles,
         }
 
     # ------------------------------------------------------------------ submit
@@ -870,6 +1067,12 @@ class ServingRouter:
                     # accept record for cross-journal recovery dedup
                     resume=routed._accepted,
                     session_id=routed.session_id,
+                    # the param-version manifest pin: the accept record
+                    # carries the session's pinned version so a worker
+                    # respawn / fleet recovery rebuilds it against the SAME
+                    # weights. None with fleet ops off keeps the record
+                    # byte-identical to pre-manifest journals.
+                    version=routed.version if self._fleet_ops else None,
                 )
             except BaseException as exc:  # noqa: BLE001
                 # a dispatch-path failure — a journal append dying on real
@@ -907,6 +1110,9 @@ class ServingRouter:
             note = routed._move_note or ("failed", "replica_failover")
             routed._move_note = None
             self._journal_note_moved(routed, status=note[0], reason=note[1])
+            # the engine's fsynced accept is now the durable anchor: close
+            # the router-journal parking entry (if this submit ever parked)
+            self._router_journal_close(routed, "moved", "dispatched")
             self.metrics.record_dispatch(routed.request_id, r.rid,
                                          load=load_at_decision)
             if self._obs_on:
@@ -928,13 +1134,36 @@ class ServingRouter:
         # no healthy replica at all: park until a breaker closes (the
         # bound, when configured, still applies — an outage must not
         # grow an unbounded router backlog). A FRESH submit parked here has
-        # never reached an engine, so on a journaled fleet it is memory-only
-        # until dispatched — the documented durability boundary
-        # (docs/serving.md "Fleet durability boundary"); failover
-        # continuations stay durable via their origin journal entry.
+        # never reached an engine, so it becomes durable through the
+        # ROUTER's own accept journal — the previously documented
+        # memory-only durability boundary, now closed: recover() replays
+        # these accepts back into the parked queue. Failover continuations
+        # stay durable via their origin journal entry instead.
         if self.max_queue_depth is not None and len(self._pending) >= self.max_queue_depth:
             self._resolve(routed, RequestStatus.REJECTED, "queue_full")
             return True
+        if (self._router_journal is not None and not routed._accepted
+                and not routed._router_journaled):
+            try:
+                self._router_journal.append_accept(
+                    routed.request_id,
+                    np.asarray(routed.prompt_ids).reshape(-1).tolist(),
+                    _journal_config_payload(routed.config),
+                    np.asarray(jax.device_get(routed.rng),
+                               np.uint32).reshape(-1).tolist(),
+                    priority=routed.priority,
+                    deadline_s=routed.deadline_s,
+                    session_id=routed.session_id,
+                    version=routed.version if self._fleet_ops else None,
+                )
+                routed._router_journaled = True
+            except BaseException:
+                # the engine's journal discipline, applied at router level:
+                # an accept that could not be made durable is REJECTED (the
+                # caller was told the submit failed, never that it was
+                # silently dropped) and the error propagates
+                self._resolve(routed, RequestStatus.REJECTED, "journal_error")
+                raise
         self._pending.append(routed)
         return False
 
@@ -986,6 +1215,27 @@ class ServingRouter:
             return
         try:
             journal.append_tick([], {}, [(engine_rid, status, reason)])
+        except Exception:  # noqa: BLE001 — durability bookkeeping, not control flow
+            pass
+
+    def _router_journal_close(self, routed: RoutedRequest,
+                              status: str, reason: str) -> None:
+        """Close a parked submit's live entry in the ROUTER's accept
+        journal: on dispatch (the engine's fsynced accept takes over as the
+        durable anchor) or on a terminal outcome while parked. Best-effort
+        for the same reason as ``_journal_note_moved`` — a broken router
+        journal must not break dispatch; the worst case is one already-
+        dispatched submit surviving to the next recovery, where the
+        session-id dedup against the replica journals drops it visibly."""
+        if not routed._router_journaled:
+            return
+        routed._router_journaled = False
+        journal = self._router_journal
+        if (journal is None or journal.failed
+                or not journal.tracks(routed.request_id)):
+            return
+        try:
+            journal.append_tick([], {}, [(routed.request_id, status, reason)])
         except Exception:  # noqa: BLE001 — durability bookkeeping, not control flow
             pass
 
@@ -1054,6 +1304,7 @@ class ServingRouter:
                 priority=routed.priority,
                 resume=routed._accepted,
                 session_id=routed.session_id,
+                version=routed.version if self._fleet_ops else None,
             )
         except BaseException as exc:  # noqa: BLE001 — replica fault containment
             self._on_tick_failure(r, exc)
@@ -1072,6 +1323,7 @@ class ServingRouter:
         note = routed._move_note or ("failed", "replica_failover")
         routed._move_note = None
         self._journal_note_moved(routed, status=note[0], reason=note[1])
+        self._router_journal_close(routed, "moved", "dispatched")
         self.metrics.record_dispatch(routed.request_id, r.rid,
                                      load=load_at_decision)
         if self._obs_on:
@@ -1360,7 +1612,12 @@ class ServingRouter:
                                           prompt_len=int(handle.prompt_ids.size))
             routed._engine_handle = handle
             routed._accepted = True
-            handle.is_resume = True  # accepted work: a later drain keeps it
+            # accepted work: a later drain keeps it. Via the engine method
+            # (not a bare attribute write) so the flag also crosses the
+            # out-of-process boundary — an EngineClient mirror handle must
+            # tell ITS worker, or the worker-side drain would prune the
+            # session as backlog (serving/transport.py).
+            r.engine.mark_resume(handle.request_id)
             routed.replica = r.rid
             r.assigned[handle.request_id] = routed
             self.metrics.record_dispatch(routed.request_id, r.rid,
@@ -1543,19 +1800,40 @@ class ServingRouter:
             self._scale_down_streak = 0
             self._scale_down(load)
 
+    def _scale_up_version(self) -> int:
+        """The param version a NEW replica should serve: the primary —
+        unless a rollout is live and its version is under-placed for the
+        fleet size the scale-up produces. The rollout pins ``fraction`` of
+        new admissions to its version, so at least ``ceil(fraction * N)``
+        of N active replicas must target it or the pinned admissions park
+        with no eligible replica (building the primary unconditionally was
+        exactly that bug — an admission black-hole the autoscaler itself
+        dug)."""
+        if self._rollout is None:
+            return self._primary_version
+        v = self._rollout["version"]
+        want = math.ceil(self._rollout["fraction"]
+                         * (len(self._active_replicas()) + 1))
+        targeting = sum(1 for r in self.replicas
+                        if not r.retired and r.target_version == v)
+        return v if targeting < want else self._primary_version
+
     def _scale_up(self, load: int) -> None:
         """Add capacity: revive the lowest-index retired slot (its journal
         directory, if any, recovers — normally empty of live sessions), or
-        append a brand-new replica at the next index."""
+        append a brand-new replica at the next index. The new replica's
+        version honors the live rollout split (``_scale_up_version``), not
+        blindly the primary."""
+        version = self._scale_up_version()
         retired = [r for r in self.replicas if r.retired]
         if retired:
             r = min(retired, key=lambda x: x.rid)
-            fresh, info = self._build_fresh(r.rid, self._primary_version)
+            fresh, info = self._build_fresh(r.rid, version)
             r.engine = fresh
             r.retired = False
             r.recycling = False
             r.breaker = BREAKER_CLOSED
-            r.version = r.target_version = self._primary_version
+            r.version = r.target_version = version
             r.consecutive_failures = r.consecutive_slow = 0
             r.nan_failures = r.open_count = r.cooldown_ticks = 0
             r._programs_seen = 0
@@ -1566,10 +1844,10 @@ class ServingRouter:
             rid = r.rid
         else:
             rid = len(self.replicas)
-            fresh, info = self._build_fresh(rid, self._primary_version)
+            fresh, info = self._build_fresh(rid, version)
             r = _Replica(rid=rid, engine=fresh,
-                         version=self._primary_version,
-                         target_version=self._primary_version)
+                         version=version,
+                         target_version=version)
             r.last_tick = self._tick
             self.replicas.append(r)
             if info:
@@ -1603,9 +1881,9 @@ class ServingRouter:
             if self._rollout is not None:
                 # an ACTIVE rollout keeps pinning a fraction of new
                 # admissions to its version: retiring the last replica
-                # targeting it would park that fraction forever (scale-up
-                # builds the primary) — a silent admission black-hole only
-                # rollback() could clear
+                # targeting it would park that fraction until the next
+                # rollout-aware scale-up — still a needless availability
+                # hole, so keep at least one
                 v = self._rollout["version"]
                 if r.target_version == v and not any(
                     o is not r and not o.retired and o.target_version == v
@@ -1703,7 +1981,122 @@ class ServingRouter:
                                               journal_terminal=not anchored):
                         r.orphaned.pop(engine_req_id)
 
+    # -------------------------------------------------------------- supervisor
+    def _respawn_worker(self, r: _Replica) -> bool:
+        """Process-mode supervisor: a replica whose WORKER PROCESS died
+        (``WorkerDiedError`` — kill -9, OOM, segfault) is respawned through
+        its own journal recovery, the same path a full-fleet ``recover``
+        takes, so its sessions come back f64 token-identical while the
+        SIBLINGS never miss a tick. Returns True when the respawn fully
+        healed the replica (no breaker strike — process death is a fault the
+        supervisor owns, not a health signal about the fresh worker); False
+        falls through to the normal breaker/failover path.
+
+        Respawn-with-recovery needs both a journal (the durable copy) and
+        fleet ops (session ids are the re-adoption match key — without them
+        recovered sessions would duplicate their failover continuations).
+        Otherwise the dead client is swapped for a fresh empty worker so the
+        slot can at least serve again after its breaker cooldown, and the
+        sessions fail over from the client-side mirrors as usual."""
+        if r.recycling or r.retired:
+            return False
+        journal_dir = (self._journal_template.format(i=r.rid)
+                       if self._journal_template else None)
+        journaled = journal_dir is not None and os.path.isdir(journal_dir)
+        if not (journaled and self._fleet_ops):
+            try:
+                old = r.engine
+                r.engine = self._make_engine(r.rid, journal_path=None,
+                                             version=r.version)
+                old.close()
+            except Exception:  # noqa: BLE001 — breaker path owns a failed spawn
+                pass
+            return False
+        # park every live hand-off exactly like a failover — EXCEPT the
+        # failover budget: a respawn re-adopts the SAME sessions from the
+        # replica's own journal, so no budget is spent and no re-dispatch
+        # happens (the parked entries match the recovered sessions by
+        # session id in _adopt_recovered below)
+        victims = sorted(r.assigned.items())
+        r.assigned.clear()
+        parked: List[RoutedRequest] = []
+        for engine_req_id, routed in victims:
+            handle = routed._engine_handle
+            if handle is not None and handle.done:
+                self._resolve(routed, handle.status, handle.finish_reason)
+                continue
+            salvaged = list(handle.output_ids) if handle is not None else []
+            if len(salvaged) > len(routed._salvaged):
+                routed._salvaged = salvaged
+            routed._engine_handle = None
+            routed.replica = None
+            # the on-disk journal holds the session live — the durable
+            # anchor while the respawn is in flight
+            routed._journal_origin = (r.rid, engine_req_id)
+            parked.append(routed)
+        if parked:
+            self._pending.extendleft(reversed(parked))
+        try:
+            r.engine.close()  # reaps the dead child; never raises
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            fresh, info = self._build_fresh(r.rid, r.version)
+        except Exception as exc:  # noqa: BLE001 — respawn failed: strike instead
+            r.last_error = f"respawn failed: {type(exc).__name__}: {exc}"
+            return False
+        r.engine = fresh
+        recovered = info["sessions"] if info else 0
+        if info:
+            # a recovered session that ALREADY continues on a sibling (its
+            # failover landed before the respawn, so the dead worker never
+            # journaled the close record) is superseded: evict it WITH a
+            # terminal record, closing the resurrected entry exactly-once
+            live_elsewhere = {
+                routed.session_id
+                for r2 in self.replicas if r2 is not r
+                for routed in r2.assigned.values()
+                if routed.session_id is not None and not routed.done
+            }
+            kept = []
+            for handle in info["handles"]:
+                if handle.session_id in live_elsewhere:
+                    r.engine.evict_request(handle.request_id, "superseded",
+                                           status=RequestStatus.FAILED,
+                                           journal_terminal=True)
+                    r.engine.finished = [h for h in r.engine.finished
+                                         if h is not handle]
+                    continue
+                kept.append(handle)
+            info["handles"] = kept
+            self._adopt_recovered(r, info)
+        # clean slate, the _finish_recycle discipline: the respawned worker
+        # is a fresh process with a fresh health record (and fresh jit
+        # caches — the compile-tick baseline must restart too)
+        r.orphaned.clear()
+        if r.breaker != BREAKER_CLOSED:
+            self._transition(r, BREAKER_CLOSED)
+        r.consecutive_failures = 0
+        r.consecutive_slow = 0
+        r.nan_failures = 0
+        r.open_count = 0
+        r.cooldown_ticks = 0
+        r._programs_seen = 0
+        r.last_tick = self._tick
+        r.last_error = None
+        self.metrics.record_respawn(r.rid, sessions=recovered,
+                                    tick=self._tick)
+        if self._obs_on:
+            self._obs.counter_inc("router.worker_respawns")
+            self._obs.instant("router.respawn", replica=r.rid,
+                              sessions=recovered)
+        return True
+
     def _on_tick_failure(self, r: _Replica, exc: BaseException) -> None:
+        if (self._replica_mode == "process"
+                and isinstance(exc, WorkerDiedError)
+                and self._respawn_worker(r)):
+            return  # supervisor healed it: no strike
         r.consecutive_failures += 1
         r.last_error = f"{type(exc).__name__}: {exc}"
         if r.breaker == BREAKER_HALF_OPEN:
@@ -1856,6 +2249,7 @@ class ServingRouter:
         routed._move_note = None
         self._journal_note_moved(routed, status=status.value,
                                  reason=reason or "resolved")
+        self._router_journal_close(routed, status.value, reason or "resolved")
         routed._terminal_status = status
         routed.finish_reason = reason
         routed.finished_at = time.perf_counter()
@@ -2039,12 +2433,41 @@ class ServingRouter:
     def write_snapshot(self) -> Dict:
         return self.metrics.write_snapshot(self._replica_snapshots())
 
+    def _transport_stats(self) -> Optional[Dict]:
+        """Fleet-aggregated transport gauges for the v12 ``transport``
+        snapshot block: RPC counts/retries/timeouts, frame and byte totals,
+        and p50/p95 RPC latency pooled across every process replica. None
+        in-process — the block's absence IS the mode marker."""
+        if self._replica_mode != "process":
+            return None
+        totals = {"rpcs": 0, "retries": 0, "timeouts": 0, "frames_sent": 0,
+                  "frames_recv": 0, "bytes_sent": 0, "bytes_recv": 0}
+        samples: List[float] = []
+        workers_alive = 0
+        for r in self.replicas:
+            stats_fn = getattr(r.engine, "transport_stats", None)
+            if stats_fn is None:
+                continue
+            stats = stats_fn()
+            for key in totals:
+                totals[key] += stats[key]
+            samples.extend(stats["rpc_ms"])
+            if getattr(r.engine, "alive", False):
+                workers_alive += 1
+        totals["workers_alive"] = workers_alive
+        totals["rpc_p50_ms"] = (round(float(np.percentile(samples, 50)), 3)
+                                if samples else None)
+        totals["rpc_p95_ms"] = (round(float(np.percentile(samples, 95)), 3)
+                                if samples else None)
+        return totals
+
     def _replica_snapshots(self) -> Dict[str, Dict]:
         self.metrics.set_fleet_gauges(
             len([r for r in self._active_replicas() if not r.recycling]),
             self.restart_in_progress,
             self._primary_version,
         )
+        self.metrics.set_transport(self._transport_stats())
         out = {}
         for r in self.replicas:
             snap = r.engine.metrics.snapshot()
@@ -2096,6 +2519,11 @@ class ServingRouter:
         self._preempt_handler = None
         for r in self.replicas:
             r.engine.close()
+        if self._router_journal is not None:
+            try:
+                self._router_journal.close()
+            except Exception:  # noqa: BLE001 — close is best-effort teardown
+                pass
         self.metrics.close()
         if self._owns_telemetry:
             self._obs.close()

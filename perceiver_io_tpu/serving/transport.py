@@ -1,0 +1,664 @@
+"""Fault-tolerant RPC transport for out-of-process serving replicas.
+
+``ServingRouter(replica_mode="process")`` swaps each in-process
+``ServingEngine`` for an ``EngineClient``: the engine itself runs in a
+separate OS process (``serving/worker.py``, spawned via ``subprocess``) and
+every call the router makes — submit / step_dispatch / step_harvest / evict /
+drain / set_params / journal bookkeeping — travels over a length-prefixed,
+CRC-framed socketpair RPC. The process boundary is the point: a worker that
+segfaults, OOMs, or is ``kill -9``-ed takes exactly one replica's interpreter
+with it, and on multi-core hosts N workers decode on N separate XLA thread
+pools instead of contending on one (the honest ``serve_bench --replicas``
+scaling the in-process fleet could never show).
+
+Frame format (one frame per RPC message, both directions)::
+
+    MAGIC(4) | payload_len(4, big-endian) | crc32(payload)(4, big-endian) | payload
+
+The payload is a pickled dict ``{"seq", "op", "payload"}`` (requests) or
+``{"seq", "ok", "value"/"error", "state"}`` (replies). A CRC mismatch at the
+worker produces a NACK (``seq=None``) and the worker executes NOTHING — a
+torn frame is retried from scratch by the client.
+
+Reliability contract:
+
+  * **Deterministic timeout/retry/backoff** — every RPC runs under
+    ``reliability/retry.py``'s ``retry_call`` with a jitter-0
+    ``RetryPolicy``, so the retry schedule is exactly reproducible (the
+    breaker-ladder discipline). Retryable failures: torn/NACKed frames,
+    socket timeouts, transient socket errors.
+  * **At-most-once execution.** Requests carry a monotone ``seq``; the
+    worker caches its last replies and answers a retried ``seq`` from the
+    cache WITHOUT re-executing, and the client discards stale buffered
+    replies whose ``seq`` doesn't match the in-flight RPC (they are
+    byte-identical cached duplicates from a timed-out earlier attempt).
+  * **Dead vs. wedged.** When retries exhaust, a worker process that has
+    EXITED surfaces ``WorkerDiedError`` (the router's supervisor respawns it
+    through journal recovery); a worker still running but unresponsive is
+    SIGKILLed by the client and surfaces ``TransportError`` (a breaker
+    strike — the hang contract).
+  * **Chaos surface** — four client-side fault points
+    (reliability/faults.py): ``transport.send.torn`` corrupts the CRC of an
+    otherwise well-formed frame, ``transport.recv.timeout`` simulates a
+    receive timeout without consuming the reply, ``transport.worker.kill``
+    SIGKILLs the real worker process, ``transport.worker.hang`` SIGSTOPs it
+    so real socket timeouts fire. All fire in the CLIENT process, scoped per
+    replica via the registry's slot targeting.
+
+Mirror handles: ``EngineClient.submit`` returns a real ``ServedRequest``
+whose state (status / output_ids / admitted_at / ...) is refreshed from the
+state bundle every RPC reply carries. The router's identity-based
+bookkeeping (``r.engine.finished`` filtering, handle adoption) works
+unchanged because the SAME mirror object is returned everywhere its
+worker-side twin would be.
+
+Kill-switch: ``PERCEIVER_IO_TPU_DISABLE_PROC_REPLICAS=1`` makes
+``replica_mode="process"`` fall back to in-process replicas — behavior
+byte-identical to the pre-transport router (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.reliability import faults
+from perceiver_io_tpu.reliability.retry import RetryError, RetryPolicy, retry_call
+from perceiver_io_tpu.serving.engine import RequestStatus, ServedRequest
+
+MAGIC = b"PIOr"
+_HEADER = struct.Struct(">II")  # payload length, crc32(payload)
+
+PROC_REPLICAS_ENV = "PERCEIVER_IO_TPU_DISABLE_PROC_REPLICAS"
+
+
+def proc_replicas_enabled() -> bool:
+    """Kill-switch for out-of-process replicas:
+    ``PERCEIVER_IO_TPU_DISABLE_PROC_REPLICAS=1`` makes
+    ``replica_mode="process"`` construct ordinary in-process engines —
+    byte-identical to the pre-transport router. Checked once at router
+    construction, the established feature-switch discipline."""
+    return os.environ.get(PROC_REPLICAS_ENV, "0").lower() in ("0", "false", "")
+
+
+class TransportError(RuntimeError):
+    """The RPC channel to a worker failed persistently (retries exhausted on
+    a worker that is still running — a wedged/hung process). The client has
+    already SIGKILLed the worker when this is raised."""
+
+
+class WorkerDiedError(TransportError):
+    """The worker PROCESS is gone (exited, crashed, or ``kill -9``-ed). On a
+    journaled fleet the router's supervisor answers this by respawning the
+    worker through journal recovery rather than striking the breaker."""
+
+
+class WorkerOpError(RuntimeError):
+    """An operation EXECUTED in the worker and raised. Not a transport
+    failure: the channel is healthy and at-most-once held — the remote
+    exception (type name + traceback in the message) simply propagates, the
+    way the in-process call would have raised."""
+
+    def __init__(self, op: str, err_type: str, err_msg: str, remote_tb: str = ""):
+        self.op = op
+        self.err_type = err_type
+        self.remote_tb = remote_tb
+        super().__init__(f"worker op {op!r} raised {err_type}: {err_msg}")
+
+
+class FrameError(OSError):
+    """A frame failed CRC validation (torn write). OSError so the retry
+    policy's default ``retry_on`` treats it as transient — nothing executed."""
+
+
+# ------------------------------------------------------------------- framing
+
+
+def encode_frame(payload: bytes, corrupt_crc: bool = False) -> bytes:
+    """One wire frame for ``payload``. ``corrupt_crc`` flips the stored CRC
+    (fault injection: the frame is well-FORMED — magic and length intact — so
+    the receiver reads it fully and rejects it on checksum, exercising the
+    NACK/retry path rather than a desync)."""
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if corrupt_crc:
+        crc ^= 0xDEADBEEF
+    return MAGIC + _HEADER.pack(len(payload), crc) + payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("transport peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    """Read one frame; raises ``FrameError`` on CRC mismatch (payload was
+    still consumed — the stream stays in sync), ``EOFError`` on a closed
+    peer, ``TimeoutError`` when the socket timeout elapses, and
+    ``TransportError`` on a magic mismatch (an unrecoverable desync)."""
+    header = _read_exact(sock, len(MAGIC) + _HEADER.size)
+    if header[: len(MAGIC)] != MAGIC:
+        raise TransportError(f"bad frame magic {header[:len(MAGIC)]!r}")
+    length, crc = _HEADER.unpack(header[len(MAGIC):])
+    payload = _read_exact(sock, length)
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise FrameError(f"frame CRC mismatch ({length} bytes)")
+    return payload
+
+
+# ------------------------------------------------------------- client shims
+
+
+class _ClientJournal:
+    """The slice of ``RequestJournal``'s surface the router touches on a
+    replica engine (``failed`` / ``tracks`` / ``append_tick``), proxied to
+    the worker that owns the real journal. ``tracks`` reads the live-rid set
+    the worker ships in every reply's state bundle; a DEAD worker reads as
+    ``failed`` (its journal cannot accept the close record — exactly the
+    fail-stop semantics ``_journal_note_moved`` already handles)."""
+
+    def __init__(self, client: "EngineClient"):
+        self._client = client
+        self._live: set = set()
+        self._worker_failed = False
+
+    @property
+    def failed(self) -> bool:
+        return self._worker_failed or not self._client.alive
+
+    def tracks(self, rid: int) -> bool:
+        return rid in self._live
+
+    def append_tick(self, admitted, tokens, terminals) -> None:
+        self._client._rpc("journal_tick", {
+            "admitted": list(admitted), "tokens": dict(tokens),
+            "terminals": [(int(r), str(s), str(why)) for r, s, why in terminals],
+        })
+
+
+class _ClientMetrics:
+    """Replica-metrics facade: ``latency_estimates()`` is refreshed from
+    every reply's state bundle (the shed estimator reads it per submit — an
+    RPC each would double dispatch latency); ``snapshot()`` is a real RPC
+    with a last-known-good fallback so ``ServingRouter.snapshot`` never
+    raises on a fleet with a dead replica."""
+
+    def __init__(self, client: "EngineClient"):
+        self._client = client
+        self._latency: Optional[Dict] = None
+        self._last_snapshot: Optional[Dict] = None
+
+    def latency_estimates(self) -> Optional[Dict]:
+        return self._latency
+
+    def snapshot(self) -> Dict:
+        try:
+            snap = self._client._rpc("snapshot", {})
+            self._last_snapshot = snap
+            return snap
+        except TransportError:
+            snap = dict(self._last_snapshot) if self._last_snapshot else {}
+            snap["worker_unreachable"] = True
+            return snap
+
+
+class _SchedulerView:
+    """``engine.scheduler.has_work``, from the cached state bundle."""
+
+    def __init__(self, client: "EngineClient"):
+        self._client = client
+
+    @property
+    def has_work(self) -> bool:
+        return self._client._has_work
+
+
+# ------------------------------------------------------------------- client
+
+
+class EngineClient:
+    """``ServingEngine``'s surface, served by a worker process.
+
+    Constructing the client spawns ``python -m perceiver_io_tpu.serving.
+    worker`` connected over a ``socketpair`` and ships it everything needed
+    to rebuild the engine: the (pickled) model module, numpy-converted
+    params, the fleet's engine knobs, the replica's journal directory, and
+    the current ``jax_enable_x64`` flag (the f64 parity pins must hold
+    across the boundary). The worker runs telemetry-off — spans cannot
+    usefully cross process lines; the router's own ``router.*`` spans still
+    cover the fleet.
+
+    Every public method is one RPC (module docstring for the reliability
+    contract). State reads the router performs BETWEEN calls — ``load``,
+    ``scheduler.has_work``, ``total_compilations``, handle attributes,
+    ``finished`` — come from the state bundle piggybacked on every reply, so
+    the hot tick path costs exactly the same two RPCs per replica
+    (dispatch + harvest) as the in-process path costs method calls."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        replica_id: int = 0,
+        metrics_jsonl: Optional[str] = None,
+        journal: Optional[str] = None,
+        rpc_timeout_s: float = 120.0,
+        init_timeout_s: float = 600.0,
+        retry: Optional[RetryPolicy] = None,
+        on_retry=None,
+        _sleep=time.sleep,
+        **engine_kwargs,
+    ):
+        import jax  # deferred: keep frame helpers importable without jax
+
+        self._rid = int(replica_id)
+        # jitter 0: the retry schedule is exactly reproducible — the same
+        # no-clocks/no-randomness discipline as the breaker cooldown ladder
+        self._policy = retry if retry is not None else RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=2.0, jitter=0.0,
+        )
+        self._rpc_timeout_s = float(rpc_timeout_s)
+        self._on_retry = on_retry
+        self._sleep = _sleep
+        self._seq = 0
+        self._requests: Dict[int, ServedRequest] = {}
+        self.finished: List[ServedRequest] = []
+        self.journal: Optional[_ClientJournal] = None
+        self.metrics = _ClientMetrics(self)
+        self.scheduler = _SchedulerView(self)
+        self.watchdog = None  # compile-watchdog summaries don't cross processes
+        self._load = 0
+        self._has_work = False
+        self._compilations = 0
+        self._closed = False
+        # transport counters (serving-metrics/v12 ``transport`` block)
+        self.rpcs = 0
+        self.retries = 0
+        self.timeouts = 0
+        self.frames_sent = 0
+        self.frames_recv = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.rpc_ms: deque = deque(maxlen=4096)
+
+        self._sock, child = socket.socketpair()
+        # the worker must resolve this package even when the client runs from
+        # a checkout that was never pip-installed
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = os.environ.copy()
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "perceiver_io_tpu.serving.worker",
+             "--fd", str(child.fileno())],
+            pass_fds=(child.fileno(),), env=env, close_fds=True,
+        )
+        child.close()
+        try:
+            self._rpc("init", {
+                "model": model,
+                "params": jax.device_get(params),
+                "engine_kwargs": dict(engine_kwargs),
+                "metrics_jsonl": metrics_jsonl,
+                "journal": journal,
+                "x64": bool(jax.config.jax_enable_x64),
+                "obs_ns": f"serving.r{self._rid}",
+            }, timeout=float(init_timeout_s))
+        except BaseException:
+            self._kill()
+            raise
+        if journal is not None:
+            self.journal = _ClientJournal(self)
+
+    # ------------------------------------------------------------- liveness
+    @property
+    def pid(self) -> Optional[int]:
+        """The worker's OS pid — the chaos harness's real ``kill -9`` target."""
+        return self._proc.pid if self._proc is not None else None
+
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def _kill(self) -> None:
+        if self._proc is not None:
+            try:
+                # SIGCONT first: a SIGSTOPped (hung) worker cannot be reaped
+                # until it runs again to take the KILL
+                os.kill(self._proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass
+            self._proc = None
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------ rpc
+    def _attempt(self, body: bytes, seq: int, timeout: float):
+        if self._proc is None:
+            raise WorkerDiedError(f"replica {self._rid}: worker already closed")
+        if self._proc.poll() is not None:
+            raise WorkerDiedError(
+                f"replica {self._rid}: worker exited rc={self._proc.returncode}")
+        # chaos hooks (module docstring): all fire CLIENT-side, scoped to
+        # this replica via the registry's slot targeting
+        if faults.fire_transport_worker_hang(self._rid) is not None:
+            os.kill(self._proc.pid, signal.SIGSTOP)
+        if faults.fire_transport_worker_kill(self._rid) is not None:
+            os.kill(self._proc.pid, signal.SIGKILL)
+            self._proc.wait(timeout=10)
+        torn = faults.fire_transport_send_torn(self._rid)
+        frame = encode_frame(body, corrupt_crc=torn)
+        try:
+            self._sock.sendall(frame)
+        except OSError as e:
+            if self._proc.poll() is not None:
+                raise WorkerDiedError(
+                    f"replica {self._rid}: worker exited mid-send") from e
+            raise
+        self.frames_sent += 1
+        self.bytes_sent += len(frame)
+        if faults.fire_transport_recv_timeout(self._rid):
+            self.timeouts += 1
+            raise TimeoutError(
+                f"injected transport recv timeout (replica {self._rid})")
+        self._sock.settimeout(timeout)
+        while True:
+            try:
+                payload = recv_frame(self._sock)
+            except EOFError as e:
+                raise WorkerDiedError(
+                    f"replica {self._rid}: worker closed the connection") from e
+            except (TimeoutError, socket.timeout):
+                self.timeouts += 1
+                raise
+            self.frames_recv += 1
+            self.bytes_recv += len(payload)
+            msg = pickle.loads(payload)
+            if msg.get("seq") is None:
+                # worker NACKed a torn frame: nothing executed, retry clean
+                raise FrameError("worker rejected frame (crc mismatch)")
+            if msg["seq"] != seq:
+                continue  # stale duplicate from an earlier timed-out attempt
+            return msg
+
+    def _rpc(self, op: str, payload: Optional[dict], timeout: Optional[float] = None,
+             _pre_apply=None):
+        """One at-most-once RPC under the deterministic retry policy.
+        Returns the op's value; raises ``WorkerOpError`` (remote exception),
+        ``WorkerDiedError`` (process gone) or ``TransportError`` (wedged
+        worker, now killed). ``_pre_apply(value)`` runs BETWEEN receiving the
+        reply and applying its state bundle — submit/recover use it to
+        register fresh mirrors so a same-reply ``finished`` entry (e.g. a
+        submit-time rejection) finds its mirror."""
+        self._seq += 1
+        seq = self._seq
+        body = pickle.dumps({"seq": seq, "op": op, "payload": payload},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        timeout = self._rpc_timeout_s if timeout is None else timeout
+        self.rpcs += 1
+        t0 = time.perf_counter()
+
+        def note_retry(attempt, exc, delay):
+            self.retries += 1
+            if self._on_retry is not None:
+                self._on_retry(self._rid, op, attempt, type(exc).__name__, delay)
+
+        try:
+            msg = retry_call(self._attempt, body, seq, timeout,
+                             policy=self._policy, sleep=self._sleep,
+                             on_retry=note_retry)
+        except RetryError as e:
+            if self._proc is not None and self._proc.poll() is None:
+                # still running but unresponsive: a wedged worker is as gone
+                # as a dead one, except it must be put down first
+                self._kill()
+                raise TransportError(
+                    f"replica {self._rid}: worker unresponsive after "
+                    f"{self._policy.attempts} attempts (killed)") from e
+            raise WorkerDiedError(
+                f"replica {self._rid}: worker died mid-RPC") from e
+        self.rpc_ms.append((time.perf_counter() - t0) * 1e3)
+        result = msg.get("value")
+        if msg["ok"] and _pre_apply is not None:
+            result = _pre_apply(result)
+        self._apply(msg.get("state"))
+        if not msg["ok"]:
+            err_type, err_msg, tb = msg["error"]
+            raise WorkerOpError(op, err_type, err_msg, tb)
+        return result
+
+    # ----------------------------------------------------------- state sync
+    @staticmethod
+    def _update_mirror(mirror: ServedRequest, st: Dict) -> None:
+        mirror.status = RequestStatus(st["status"])
+        mirror.finish_reason = st["finish_reason"]
+        mirror.output_ids = list(st["output_ids"])
+        mirror.admitted_at = st["admitted_at"]
+        mirror.finished_at = st["finished_at"]
+        mirror.preemptions = st["preemptions"]
+        mirror.slot = st["slot"]
+
+    def _apply(self, bundle: Optional[Dict]) -> None:
+        if bundle is None:
+            return
+        self._load = bundle["load"]
+        self._has_work = bundle["has_work"]
+        self._compilations = bundle["total_compilations"]
+        self.metrics._latency = bundle["latency_estimates"]
+        for rid, st in bundle["requests"].items():
+            mirror = self._requests.get(rid)
+            if mirror is not None:
+                self._update_mirror(mirror, st)
+        for rid, st in bundle["finished"]:
+            mirror = self._requests.pop(rid, None)
+            if mirror is None:
+                continue  # a handle this client never tracked (defensive)
+            self._update_mirror(mirror, st)
+            self.finished.append(mirror)
+        if self.journal is not None:
+            self.journal._live = set(bundle["journal_live"] or ())
+            self.journal._worker_failed = bool(bundle["journal_failed"])
+
+    def _make_mirror(self, st: Dict) -> ServedRequest:
+        mirror = ServedRequest(
+            request_id=st["rid"],
+            prompt_ids=np.asarray(st["prompt"], np.int32),
+            config=st["config"],
+            rng=st["rng"],
+            priority=st["priority"],
+            deadline_s=st["deadline_s"],
+            session_id=st["session_id"],
+            version=st.get("version"),
+            is_resume=st.get("is_resume", False),
+        )
+        self._update_mirror(mirror, st)
+        return mirror
+
+    # -------------------------------------------------------- engine surface
+    @property
+    def load(self) -> int:
+        return self._load
+
+    @property
+    def total_compilations(self) -> int:
+        return self._compilations
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        config=None,
+        rng=None,
+        deadline_s: Optional[float] = None,
+        replay_ids: Optional[Sequence[int]] = None,
+        priority: int = 0,
+        resume: bool = False,
+        session_id: Optional[str] = None,
+        version: Optional[int] = None,
+        **kwargs,
+    ) -> ServedRequest:
+        """Mirror of ``ServingEngine.submit``: the worker runs the real
+        submit; the returned handle is a client-side mirror refreshed on
+        every subsequent RPC."""
+        import jax
+
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def register(value):
+            mirror = self._make_mirror(value["state"])
+            self._requests[mirror.request_id] = mirror
+            return mirror
+
+        return self._rpc("submit", {
+            "prompt": np.asarray(prompt_ids, np.int32),
+            "config": config,
+            "kwargs": kwargs,
+            "rng": np.asarray(jax.device_get(rng), np.uint32),
+            "deadline_s": deadline_s,
+            "replay_ids": None if replay_ids is None
+            else np.asarray(replay_ids, np.int32),
+            "priority": int(priority),
+            "resume": bool(resume),
+            "session_id": session_id,
+            "version": version,
+        }, _pre_apply=register)
+
+    def step_dispatch(self) -> bool:
+        return self._rpc("step_dispatch", {})
+
+    def step_harvest(self) -> None:
+        self._rpc("step_harvest", {})
+
+    def discard_pending_harvest(self) -> None:
+        try:
+            self._rpc("discard_pending_harvest", {})
+        except TransportError:
+            pass  # a dead worker has nothing pending to discard
+
+    def _begin_drain(self) -> None:
+        self._rpc("begin_drain", {})
+
+    def evict_request(
+        self, request_id: int, reason: str = "cancelled",
+        status: RequestStatus = RequestStatus.FAILED,
+        queued_only: bool = False,
+        journal_terminal: bool = True,
+    ) -> Optional[ServedRequest]:
+        mirror = self._requests.get(request_id)
+        evicted = self._rpc("evict", {
+            "rid": int(request_id), "reason": reason, "status": status.value,
+            "queued_only": bool(queued_only),
+            "journal_terminal": bool(journal_terminal),
+        })
+        if not evicted:
+            return None
+        # the mirror moved to ``finished`` via the reply's state bundle;
+        # return the same object identity the in-process evict would
+        return mirror
+
+    def mark_resume(self, request_id: int) -> None:
+        mirror = self._requests.get(request_id)
+        if mirror is not None:
+            mirror.is_resume = True
+        self._rpc("mark_resume", {"rid": int(request_id)})
+
+    def set_params(self, params) -> None:
+        import jax
+
+        self._rpc("set_params", {"params": jax.device_get(params)})
+
+    def _recover_attach(self, journal_path, fsync: str = "accept",
+                        segment_max_records: int = 4096,
+                        skip_session_ids=frozenset(), _state=None) -> dict:
+        """``ServingEngine._recover_attach`` across the boundary: the worker
+        replays the journal directory into its (fresh, journal-less) engine
+        and swaps the generation; the client builds mirrors for the
+        recovered handles so ``ServingRouter``'s adoption bookkeeping works
+        unchanged. ``_state`` (the router's pre-parsed dedup scan) is not
+        shipped — the worker re-reads the directory itself; both read the
+        same on-disk generation, so the result is identical."""
+        def register(info):
+            handles = []
+            for st in info.pop("handle_states"):
+                mirror = self._make_mirror(st)
+                self._requests[mirror.request_id] = mirror
+                handles.append(mirror)
+            info["handles"] = handles
+            return info
+
+        info = self._rpc("recover_attach", {
+            "path": os.path.abspath(os.fspath(journal_path)),
+            "fsync": fsync,
+            "segment_max_records": int(segment_max_records),
+            "skip_session_ids": sorted(skip_session_ids),
+        }, _pre_apply=register)
+        if self.journal is None:
+            self.journal = _ClientJournal(self)
+            self.journal._live = set(h.request_id for h in info["handles"])
+        return info
+
+    def transport_stats(self) -> Dict:
+        """Raw transport counters + RPC latency samples (ms) — aggregated
+        across replicas into the v12 ``transport`` snapshot block."""
+        return {
+            "rpcs": self.rpcs,
+            "retries": self.retries,
+            "timeouts": self.timeouts,
+            "frames_sent": self.frames_sent,
+            "frames_recv": self.frames_recv,
+            "bytes_sent": self.bytes_sent,
+            "bytes_recv": self.bytes_recv,
+            "rpc_ms": list(self.rpc_ms),
+        }
+
+    def close(self) -> None:
+        """Graceful worker shutdown: one best-effort close RPC (flushes the
+        worker's journal + metrics), then the process is reaped. Idempotent;
+        never raises — close is the router's teardown path and must work on
+        a dead replica."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.alive:
+            try:
+                self._rpc("close", {}, timeout=30.0)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            if self._proc is not None:
+                try:
+                    self._proc.wait(timeout=30)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._kill()
+
+    def __del__(self):  # pragma: no cover - interpreter-shutdown ordering
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

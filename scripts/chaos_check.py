@@ -117,6 +117,24 @@ Router group (docs/serving.md, multi-replica router; ``ServingRouter``):
   * ``router_drain``       fleet drain rejects every backlog, finishes every
                            active slot, and keeps admission closed
 
+Process-replica group (out-of-process workers; serving/transport.py,
+``ServingRouter(replica_mode="process")``):
+
+  * ``proc_replica_kill9`` a REAL ``kill -9`` lands on a worker process
+                           mid-decode (``transport.worker.kill``); the
+                           supervisor respawns it through journal recovery —
+                           the victim's sessions finish f64 token-identical
+                           on the NEW process with zero failovers, siblings
+                           bit-identical, the victim recovered exactly once,
+                           repeat-run deterministic
+  * ``transport_torn_frame`` a CRC-torn RPC frame is NACKed by the worker
+                           WITHOUT executing and absorbed by the retry
+                           schedule (tokens identical, breakers closed); a
+                           channel tearing EVERY frame exhausts retries,
+                           the wedged worker is put down, the breaker
+                           strikes, and sessions fail over — no corrupt
+                           state either way
+
 Every scenario is deterministic: fault firing is counter-based (no clocks, no
 randomness — reliability/faults.py), model/workload seeds are fixed, so a
 failure here reproduces exactly.
@@ -1317,6 +1335,155 @@ def check_router_drain() -> dict:
     }
 
 
+def check_proc_replica_kill9() -> dict:
+    """A REAL ``kill -9`` lands on an out-of-process replica worker
+    mid-decode (``transport.worker.kill``): the router's supervisor respawns
+    the worker through journal recovery — the victim's sessions finish f64
+    token-identical on the NEW process with zero failovers, survivors on the
+    sibling replica are bit-identical throughout, the victim is recovered
+    exactly once, and a repeat run pins identical statuses/tokens."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    prompts = [[1, 2, 3], [4, 5, 6], [2, 4]]
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+
+        # in-process reference: the token-identity target for every session
+        ref = ServingRouter(model, params, num_replicas=2, num_slots=2)
+        ref_handles = [ref.submit(p, max_new_tokens=6) for p in prompts]
+        ref.run_until_drained(max_steps=300)
+        ref_tokens = [list(h.output_ids) for h in ref_handles]
+        ref.close()
+
+        def run_once(tmp):
+            router = ServingRouter(
+                model, params, num_replicas=2, num_slots=2,
+                journal=os.path.join(tmp, "r{i}"), replica_mode="process",
+            )
+            try:
+                handles = [router.submit(p, max_new_tokens=6) for p in prompts]
+                for _ in range(3):
+                    router.step()  # several tokens in: the kill is MID-decode
+                victim_rid = handles[0].replica
+                # the fault point fires a REAL os.kill(pid, SIGKILL) on the
+                # worker at the victim replica's next RPC
+                with armed("transport.worker.kill", slot=victim_rid, times=1):
+                    router.run_until_drained(max_steps=300)
+                snap = router.snapshot()
+                transport = snap["transport"]
+                return {
+                    "statuses": [h.status.value for h in handles],
+                    "tokens": [list(h.output_ids) for h in handles],
+                    "failovers": [h.failovers for h in handles],
+                    "respawns": transport["worker_respawns"],
+                    "workers_alive": transport["workers_alive"],
+                    "fleet_failovers": snap["failovers"],
+                    "breaker_transitions": dict(snap["breaker_transitions"]),
+                    "accounted": (
+                        snap["requests_submitted"]
+                        == snap["requests_finished"] + snap["rejected"]
+                        + snap["timed_out"] + snap["failed"]
+                    ),
+                }
+            finally:
+                router.close()
+
+        with tempfile.TemporaryDirectory() as tmp_a, \
+                tempfile.TemporaryDirectory() as tmp_b:
+            first = run_once(tmp_a)
+            second = run_once(tmp_b)  # repeat-run determinism
+
+    token_identical = first["tokens"] == ref_tokens
+    recovered_once = first["respawns"] == 1
+    return {
+        "ok": (
+            all(s == "finished" for s in first["statuses"])
+            and token_identical
+            and recovered_once
+            and first["fleet_failovers"] == 0
+            and all(f == 0 for f in first["failovers"])
+            and first["breaker_transitions"] == {}
+            and first["workers_alive"] == 2
+            and first["accounted"]
+            and second == first
+        ),
+        "token_identical_after_respawn": token_identical,
+        "victim_recovered_exactly_once": recovered_once,
+        "failovers": first["fleet_failovers"],
+        "breaker_transitions": first["breaker_transitions"],
+        "repeat_deterministic": second == first,
+    }
+
+
+def check_transport_torn_frame() -> dict:
+    """A torn RPC frame (``transport.send.torn`` corrupts the CRC) is NACKed
+    by the worker WITHOUT executing and absorbed by the deterministic retry
+    schedule — tokens f64-identical, breakers closed. A replica whose channel
+    tears EVERY frame exhausts retries, is put down as wedged, strikes its
+    breaker, and its sessions fail over — no corrupt state either way."""
+    from perceiver_io_tpu.serving import ServingRouter
+
+    prompts = [[1, 2, 3], [4, 5, 6]]
+    with _x64():
+        model, params = _serving_setup(param_dtype=jnp.float64)
+
+        ref = ServingRouter(model, params, num_replicas=2, num_slots=1)
+        ref_handles = [ref.submit(p, max_new_tokens=6) for p in prompts]
+        ref.run_until_drained(max_steps=300)
+        ref_tokens = [list(h.output_ids) for h in ref_handles]
+        ref.close()
+
+        # arm 1 — ONE torn frame: NACK -> retry resends -> absorbed
+        router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                               replica_mode="process")
+        try:
+            handles = [router.submit(p, max_new_tokens=6) for p in prompts]
+            router.step()
+            with armed("transport.send.torn", times=1):
+                router.run_until_drained(max_steps=300)
+            snap1 = router.snapshot()
+            t1 = snap1["transport"]
+            one_tokens = [list(h.output_ids) for h in handles]
+        finally:
+            router.close()
+
+        # arm 2 — EVERY frame to replica 1 torn: retries exhaust, the wedged
+        # worker is killed by the client, the breaker strikes, sessions fail
+        # over to the healthy replica (cooldown long enough that no HALF_OPEN
+        # probe re-enters the torn channel during the drain)
+        router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                               replica_mode="process",
+                               breaker_cooldown_ticks=500)
+        try:
+            handles2 = [router.submit(p, max_new_tokens=6) for p in prompts]
+            router.step()
+            with armed("transport.send.torn", slot=1, times=None):
+                router.run_until_drained(max_steps=300)
+            snap2 = router.snapshot()
+            two_tokens = [list(h.output_ids) for h in handles2]
+        finally:
+            router.close()
+
+    one_identical = one_tokens == ref_tokens
+    two_identical = two_tokens == ref_tokens
+    return {
+        "ok": (
+            all(h.ok for h in handles) and one_identical
+            and t1["rpc_retries"] >= 1
+            and snap1["failovers"] == 0
+            and t1["worker_respawns"] == 0
+            and snap1["breaker_transitions"] == {}
+            and all(h.ok for h in handles2) and two_identical
+            and snap2["breaker_transitions"].get("closed->open") == 1
+            and snap2["failovers"] >= 1
+        ),
+        "retry_absorbed_tokens_identical": one_identical,
+        "retries_single_tear": t1["rpc_retries"],
+        "persistent_tear_breaker_open": snap2["breaker_transitions"].get("closed->open"),
+        "persistent_tear_failed_over_ok": two_identical,
+    }
+
+
 CHECKS = {
     "no_fault_inert": check_no_fault_inert,
     "flaky_loader": check_flaky_loader,
@@ -1338,6 +1505,8 @@ CHECKS = {
     "chunked_prefill_recovery": check_chunked_prefill_recovery,
     "ragged_tick_churn": check_ragged_tick_churn,
     "router_crash_failover": check_router_crash_failover,
+    "proc_replica_kill9": check_proc_replica_kill9,
+    "transport_torn_frame": check_transport_torn_frame,
     "router_stall_breaker": check_router_stall_breaker,
     "router_shed_overload": check_router_shed_overload,
     "router_drain": check_router_drain,

@@ -262,6 +262,24 @@ def report_serving_metrics(path: str) -> Dict:
         # serving-metrics/v11 unified-ragged-tick gauges (None: dense
         # engine, router snapshot, or pre-v11 stream)
         out["ragged_tick"] = snap.get("ragged_tick")
+        # serving-metrics/v12 out-of-process transport gauges (None:
+        # in-process fleet, plain engine, or pre-v12 stream)
+        out["transport"] = snap.get("transport")
+        respawns = [e for e in loaded["events"] if e.get("event") == "respawn"]
+        if respawns:
+            out["respawn_events"] = {
+                "count": len(respawns),
+                "sessions_recovered": sum(e.get("sessions", 0)
+                                          for e in respawns),
+            }
+        rpc_retries = [e for e in loaded["events"]
+                       if e.get("event") == "rpc_retry"]
+        if rpc_retries:
+            out["rpc_retry_events"] = {
+                "count": len(rpc_retries),
+                "by_op": {op: sum(1 for e in rpc_retries if e.get("op") == op)
+                          for op in sorted({e.get("op") for e in rpc_retries})},
+            }
         migrations = [e for e in loaded["events"] if e.get("event") == "migrate"]
         if migrations:
             out["migrate_events"] = {
@@ -449,6 +467,21 @@ def main(argv=None) -> Dict:
                           f"{row.get('finished')} finished, "
                           f"{row.get('tokens_generated')} tokens")
             for key in ("migrate_events", "recycle_events", "autoscale_events"):
+                if section.get(key):
+                    print(f"  {key}:", json.dumps(section[key]))
+        # v12 out-of-process transport rendering (suppressed where the
+        # reader normalized to None: in-process fleet or pre-v12 stream) —
+        # the RPC tax and the supervisor's respawn ledger
+        tp = section.get("transport")
+        if tp:
+            print("transport: "
+                  f"{tp.get('rpcs')} rpcs "
+                  f"(p50={tp.get('rpc_p50_ms')}ms p95={tp.get('rpc_p95_ms')}ms), "
+                  f"{tp.get('retries')} retries, {tp.get('timeouts')} timeouts, "
+                  f"{tp.get('worker_respawns')} worker respawns, "
+                  f"{tp.get('workers_alive')} workers alive, "
+                  f"{tp.get('bytes_sent')}B out / {tp.get('bytes_recv')}B in")
+            for key in ("respawn_events", "rpc_retry_events"):
                 if section.get(key):
                     print(f"  {key}:", json.dumps(section[key]))
         # v7 journal health + recovery rendering (suppressed on journal-less

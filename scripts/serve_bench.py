@@ -50,7 +50,11 @@ median-of-``--replica-repeats``), reporting aggregate admission tokens/s
 (time until the burst's last admission — the capacity dimension replicas
 add) and drain tokens/s, with the v4 shed/failover counters; the block is
 merged into the ``--profile-out`` artifact (BENCH_serving.json) with its run
-manifest.
+manifest. ``--proc`` runs the N-replica arm with OUT-OF-PROCESS workers
+(``replica_mode="process"``, serving/transport.py) against the same
+in-process 1-replica baseline — greedy tokens asserted identical across
+arms, RPC p50/p95 reported next to the throughput — and lands under
+``replica_scaling_proc``.
 
 Runs anywhere: ``JAX_PLATFORMS=cpu python scripts/serve_bench.py --preset tiny``
 finishes in under a minute and is what tests/test_serving.py smoke-drives.
@@ -210,7 +214,8 @@ def run_engine(model, params, requests, num_slots: int, jsonl_path, warmup: bool
 
 
 def run_replica_scaling(model, params, requests, num_replicas: int,
-                        num_slots: int, repeats: int = 3) -> dict:
+                        num_slots: int, repeats: int = 3,
+                        replica_mode: str = "inproc") -> dict:
     """ROADMAP item 2's bench target: aggregate ADMISSION tokens/s scaling
     with replica count. A burst of ``len(requests)`` requests (sized ~6x one
     replica's slots) hits a 1-replica router and an N-replica router
@@ -225,7 +230,20 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
     both rates scale. Arms are INTERLEAVED A/B/A/B with the wall kept
     per arm as the MEDIAN of the interleaved passes (back-to-back arms pick
     up allocator warm-up drift; minima flip under shared-CPU noise). Admission-control counters ride along so a
-    shedding/failing fleet can't pass as a fast one."""
+    shedding/failing fleet can't pass as a fast one.
+
+    ``replica_mode="process"`` runs the N-replica arm with OUT-OF-PROCESS
+    workers (serving/transport.py) against the same in-process 1-replica
+    baseline — each worker owns its own interpreter and XLA pool, so on a
+    host with >= N free cores the drain rate measures the near-linear
+    scaling process isolation unlocks (in-process replicas contend on one
+    GIL + one XLA pool). The same honesty discipline as above applies in
+    reverse on a SINGLE-core host: there the workers time-slice one core
+    and every RPC costs two context switches, so the process arm reads
+    SLOWER than in-process — the block records ``cores`` so the ratio is
+    interpretable, and the number is reported un-gamed either way. Greedy
+    tokens are asserted identical between the arms on every pass: the
+    process boundary must be invisible to outputs."""
     from perceiver_io_tpu.serving import ServingRouter
 
     # telemetry=False: ambient PERCEIVER_IO_TPU_TELEMETRY must not switch
@@ -234,7 +252,8 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
         1: ServingRouter(model, params, num_replicas=1, num_slots=num_slots,
                          telemetry=False),
         num_replicas: ServingRouter(model, params, num_replicas=num_replicas,
-                                    num_slots=num_slots, telemetry=False),
+                                    num_slots=num_slots, telemetry=False,
+                                    replica_mode=replica_mode),
     }
 
     def one_pass(router):
@@ -248,15 +267,21 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
         drain_wall = time.perf_counter() - t0
         assert all(h.ok for h in handles)  # a degraded pass must not be timed
         admit_wall = max(h.admitted_at for h in handles) - t0
-        return admit_wall, drain_wall
+        return admit_wall, drain_wall, [h.result().tolist() for h in handles]
 
-    for router in routers.values():  # warmup: compiles every covering bucket
-        one_pass(router)
+    tokens_by_arm = {}
+    for n, router in routers.items():  # warmup: compiles every covering bucket
+        _, _, tokens_by_arm[n] = one_pass(router)
+    # the cross-arm identity pin: replica count AND the process boundary are
+    # invisible to greedy outputs (a diverging timed arm must not be scored)
+    assert tokens_by_arm[num_replicas] == tokens_by_arm[1], \
+        "replica arms diverged on greedy tokens"
     admit_walls = {n: [] for n in routers}
     drain_walls = {n: [] for n in routers}
     for _ in range(repeats):
         for n, router in routers.items():  # interleaved A/B
-            a, d = one_pass(router)
+            a, d, toks = one_pass(router)
+            assert toks == tokens_by_arm[1], "greedy tokens drifted across passes"
             admit_walls[n].append(a)
             drain_walls[n].append(d)
 
@@ -272,6 +297,7 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
         snap = router.snapshot()
         arms[f"replicas_{n}"] = {
             "replicas": n,
+            "replica_mode": "inproc" if n == 1 else replica_mode,
             "slots_per_replica": num_slots,
             "admission_wall_seconds": round(admit, 4),
             "admission_wall_all_repeats": [round(w, 4) for w in admit_walls[n]],
@@ -288,6 +314,15 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
             "failed": snap["failed"],
             "breaker_transitions": snap["breaker_transitions"],
         }
+        if snap.get("transport") is not None:
+            # process-mode arm: the RPC tax rides next to the throughput it
+            # bought (rpc p50/p95, retries, respawns — serving-metrics/v12)
+            arms[f"replicas_{n}"]["transport"] = {
+                k: snap["transport"][k]
+                for k in ("rpcs", "rpc_p50_ms", "rpc_p95_ms", "retries",
+                          "timeouts", "worker_respawns")
+                if k in snap["transport"]
+            }
         router.close()
     single = arms["replicas_1"]
     multi = arms[f"replicas_{num_replicas}"]
@@ -295,6 +330,9 @@ def run_replica_scaling(model, params, requests, num_replicas: int,
         "requests": len(requests),
         "new_tokens_per_pass": new_tokens,
         "prompt_tokens_per_pass": prompt_tokens,
+        "replica_mode": replica_mode,
+        "cores": os.cpu_count(),  # the scaling ceiling: N replicas need N cores
+        "tokens_identical_across_arms": True,  # asserted on every pass above
         **arms,
         "throughput_speedup": round(multi["tokens_per_s"] / single["tokens_per_s"], 3)
         if single["tokens_per_s"] > 0 else 0.0,
@@ -1838,6 +1876,12 @@ def main(argv=None) -> dict:
                          "median-of --replica-repeats); the block lands in the "
                          "--profile-out artifact (BENCH_serving.json)")
     ap.add_argument("--replica-repeats", type=int, default=7)
+    ap.add_argument("--proc", action="store_true",
+                    help="run the replica-scaling arm's N-replica router with "
+                         "OUT-OF-PROCESS workers (replica_mode='process', "
+                         "serving/transport.py) against the in-process "
+                         "1-replica baseline; the block lands under "
+                         "replica_scaling_proc in the --profile-out artifact")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="run the fleet-operations arm (docs/serving.md "
                          "'Fleet operations'): a streamed workload through a "
@@ -1934,8 +1978,11 @@ def main(argv=None) -> dict:
         workload = synth_workload(config, 6 * args.slots, args.seed)
         for r in workload:
             r["max_new_tokens"] = 24
-        scaling = run_replica_scaling(model, params, workload, args.replicas,
-                                      args.slots, repeats=args.replica_repeats)
+        scaling = run_replica_scaling(
+            model, params, workload, args.replicas, args.slots,
+            repeats=args.replica_repeats,
+            replica_mode="process" if args.proc else "inproc",
+        )
         scaling["preset"] = args.preset  # the merged artifact may mix presets
         return scaling
 
@@ -1955,7 +2002,8 @@ def main(argv=None) -> dict:
                           params=profile_params),
         }
         if args.replicas >= 2:
-            result["replica_scaling"] = replica_arm(model, config, profile_params)
+            key = "replica_scaling_proc" if args.proc else "replica_scaling"
+            result[key] = replica_arm(model, config, profile_params)
         if args.page_size > 0:
             result["paging"] = paging_arm(model, config, profile_params)
         if args.kv_quant > 0:
@@ -2015,11 +2063,14 @@ def main(argv=None) -> dict:
 
     if args.replicas >= 2:
         scaling = replica_arm(model, config, params)
-        result["replica_scaling"] = scaling
+        # --proc lands under its own key so the in-process scaling numbers
+        # and the process-isolation numbers are tracked side by side
+        scaling_key = "replica_scaling_proc" if args.proc else "replica_scaling"
+        result[scaling_key] = scaling
         # the replica-scaling arm is part of the per-PR BENCH_serving.json
         # story even without --profile: merge it into the existing artifact
         # (other sections preserved) so the tracked file carries both
-        merge_section("replica_scaling", scaling, result["recorded_at"])
+        merge_section(scaling_key, scaling, result["recorded_at"])
     if args.page_size > 0:
         paging = paging_arm(model, config, params)
         result["paging"] = paging

@@ -488,7 +488,7 @@ def test_run_manifest_contents(tmp_path):
     assert manifest["versions"]["jax"] == jax.__version__
     assert manifest["devices"]["count"] >= 1 and manifest["devices"]["backend"]
     assert manifest["config"] == {"preset": "tiny", "slots": 4}
-    assert manifest["artifact_schemas"]["serving_metrics"] == "serving-metrics/v11"
+    assert manifest["artifact_schemas"]["serving_metrics"] == "serving-metrics/v12"
     assert manifest["artifact_schemas"]["train_metrics"] == "train-metrics/v1"
     # config objects that are not JSON-encodable degrade to repr, never raise
     weird = build_run_manifest(config={"fn": open})  # a builtin is unencodable

@@ -8,7 +8,7 @@ token streams are IDENTICAL to the composed per-program tick the
 ``PERCEIVER_IO_TPU_DISABLE_RAGGED_TICK`` kill-switch restores: f64-exact on
 fp engines (near-tie argmax flips cannot mask a real bug), exact token
 equality on int8/int4 engines. The compile-count invariant tightens to
-"the tick program compiles exactly once, ever" and the serving-metrics/v11
+"the tick program compiles exactly once, ever" and the serving-metrics/v12
 ``ragged_tick`` block pins programs-per-tick at 1.
 """
 
@@ -282,11 +282,11 @@ def test_serve_bench_ragged_arm_smoke(tmp_path):
 
 
 def test_schema_v11_and_reader_normalizes_pre_v11(tmp_path):
-    """The writer stamps serving-metrics/v11; the reader backfills
+    """The writer stamps serving-metrics/v12; the reader backfills
     ragged_tick: None onto pre-v11 snapshots (and dense engines truthfully
     report None — 'not recorded' stays indistinguishable from 'no tick
     dispatcher exists', the schema's long-standing discipline)."""
-    assert SCHEMA == "serving-metrics/v11"
+    assert SCHEMA == "serving-metrics/v12"
     path = tmp_path / "old.jsonl"
     path.write_text(json.dumps({
         "event": "snapshot", "schema": "serving-metrics/v10",

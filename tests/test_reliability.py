@@ -613,7 +613,7 @@ def test_metrics_v3_reader_normalizes_older_snapshots(tmp_path):
     from perceiver_io_tpu.serving import EngineMetrics, load_metrics_jsonl
     from perceiver_io_tpu.serving.metrics import SCHEMA
 
-    assert SCHEMA == "serving-metrics/v11"
+    assert SCHEMA == "serving-metrics/v12"
     path = tmp_path / "v3.jsonl"
     m = EngineMetrics(num_slots=2, jsonl_path=str(path))
     m.record_submit(0, prompt_len=3)
@@ -664,13 +664,15 @@ def _load_chaos():
 
 # the journal group (and the chunked-prefill recovery + migration-window
 # crash scenarios, which ride the same subprocess kill harness, plus the
-# rolling-restart scenario's two full fleet drains) runs in its own tests
+# rolling-restart scenario's two full fleet drains, plus the process-replica
+# scenarios that spawn REAL worker processes) runs in its own tests
 # below — real subprocess kills and four compaction recovery cycles blow the
 # 120s per-test alarm budget when stacked on the rest of the matrix;
 # together the tests cover every scenario
 _JOURNAL_CHECKS = ("journal_crash_restart", "journal_torn_tail",
                    "journal_compaction_crash", "chunked_prefill_recovery",
-                   "migrate_crash_midflight", "rolling_restart_under_load")
+                   "migrate_crash_midflight", "rolling_restart_under_load",
+                   "proc_replica_kill9", "transport_torn_frame")
 
 
 def test_chaos_check_matrix_green(tmp_path):
@@ -707,6 +709,33 @@ def test_chaos_journal_crash_restart_real_sigkill():
     mod = _load_chaos()
     result = mod.main(["--checks", "journal_crash_restart"])
     assert result["all_ok"], result["checks"]["journal_crash_restart"]
+
+
+def test_chaos_proc_replica_kill9_real_sigkill():
+    """Process-replica chaos (ISSUE 20 acceptance): a REAL ``kill -9`` on an
+    out-of-process worker mid-decode is healed by the supervisor through
+    journal recovery — victim sessions f64 token-identical on the respawned
+    worker with zero failovers, siblings bit-identical, the victim recovered
+    exactly once, repeat-run deterministic."""
+    mod = _load_chaos()
+    result = mod.main(["--checks", "proc_replica_kill9"])
+    check = result["checks"]["proc_replica_kill9"]
+    assert result["all_ok"], check
+    assert check["victim_recovered_exactly_once"]
+    assert check["repeat_deterministic"]
+
+
+def test_chaos_transport_torn_frame():
+    """Transport chaos (ISSUE 20): a CRC-torn frame is NACKed without
+    executing and absorbed by the retry schedule; a persistently torn channel
+    exhausts retries, strikes the breaker, and fails sessions over — tokens
+    identical in both arms (no corrupt state)."""
+    mod = _load_chaos()
+    result = mod.main(["--checks", "transport_torn_frame"])
+    check = result["checks"]["transport_torn_frame"]
+    assert result["all_ok"], check
+    assert check["retries_single_tear"] >= 1
+    assert check["persistent_tear_breaker_open"] == 1
 
 
 def test_chaos_chunked_prefill_recovery_real_sigkill():

@@ -1,0 +1,290 @@
+"""Out-of-process replica transport tests (ISSUE 20): CRC frame integrity,
+the deterministic timeout->retry->backoff schedule, wedged-worker breaker
+strikes, the router-level accept journal that makes PARKED fresh submits
+survive a full-fleet outage, and kill-switch inertness.
+
+The reliability contract under test (serving/transport.py module docstring):
+torn frames are NACKed by the worker WITHOUT executing and absorbed by the
+jitter-0 retry policy; a timed-out reply is answered from the worker's seq
+cache at-most-once; a worker that stops answering is put down and surfaces
+``TransportError`` (breaker strike), while a DEAD process surfaces
+``WorkerDiedError`` (supervisor respawn). Token-identity pins run in float64
+where greedy equality is exact across the process boundary.
+"""
+
+import socket
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from perceiver_io_tpu.models.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.models.core.perceiver_ar import CausalSequenceModel
+from perceiver_io_tpu.reliability import armed
+from perceiver_io_tpu.reliability.retry import RetryPolicy
+from perceiver_io_tpu.serving import (
+    EngineClient,
+    FrameError,
+    TERMINAL_STATUSES,
+    ServingEngine,
+    ServingRouter,
+    TransportError,
+    proc_replicas_enabled,
+    read_journal,
+)
+from perceiver_io_tpu.serving.transport import (
+    PROC_REPLICAS_ENV,
+    encode_frame,
+    recv_frame,
+)
+
+VOCAB = 60
+WINDOW = 12
+
+
+def _make_model(param_dtype=jnp.float32):
+    config = CausalSequenceModelConfig(
+        vocab_size=VOCAB, max_seq_len=WINDOW, max_latents=6, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalSequenceModel(config=config, param_dtype=param_dtype)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (1, 8), 0, VOCAB)
+    params = jax.jit(model.init, static_argnames="prefix_len")(rng, prompt, prefix_len=2)
+    return model, params
+
+
+def _engine_reference(model, params, prompts, max_new):
+    engine = ServingEngine(model, params, num_slots=max(len(prompts), 1))
+    handles = [engine.submit(p, max_new_tokens=m) for p, m in zip(prompts, max_new)]
+    engine.run_until_drained(max_steps=500)
+    return [h.result().tolist() for h in handles]
+
+
+# ------------------------------------------------------------------ framing
+def test_frame_roundtrip_and_crc_rejection():
+    """Wire-level contract, no worker involved: a frame roundtrips its
+    payload exactly; a CRC-corrupted frame is consumed IN SYNC and rejected
+    as ``FrameError`` (the retryable class); a magic mismatch is the
+    unrecoverable ``TransportError``; a closed peer reads as ``EOFError``."""
+    a, b = socket.socketpair()
+    try:
+        payload = b"x" * 70_000  # bigger than one recv() chunk: exercises _read_exact
+        a.sendall(encode_frame(payload))
+        assert recv_frame(b) == payload
+
+        # torn frame: well-formed (magic + length intact) but CRC flipped —
+        # rejected, and the NEXT frame still parses (stream stayed in sync)
+        a.sendall(encode_frame(b"torn payload", corrupt_crc=True))
+        a.sendall(encode_frame(b"clean payload"))
+        with pytest.raises(FrameError):
+            recv_frame(b)
+        assert recv_frame(b) == b"clean payload"
+
+        a.sendall(b"XXXX" + encode_frame(b"late")[4:])
+        with pytest.raises(TransportError):
+            recv_frame(b)
+
+        a.close()
+        with pytest.raises(EOFError):
+            recv_frame(b)
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------- retry/backoff determinism
+def test_timeout_retry_backoff_deterministic_and_at_most_once(x64):
+    """Two injected reply timeouts on one RPC (``transport.recv.timeout``)
+    are retried on the exact jitter-0 exponential schedule — the recorded
+    sleeps ARE ``base * 2^(attempt-1)`` — and the op executes at-most-once
+    (the retried seq is answered from the worker's reply cache), so the
+    decode stays f64 token-identical to the in-process engine."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    [expected] = _engine_reference(model, params, [[7, 3, 9]], [4])
+
+    sleeps = []
+    client = EngineClient(
+        model, params, replica_id=0, rpc_timeout_s=30.0,
+        retry=RetryPolicy(attempts=3, base_delay_s=0.05, max_delay_s=2.0, jitter=0.0),
+        _sleep=sleeps.append, num_slots=1,
+    )
+    try:
+        handle = client.submit([7, 3, 9], max_new_tokens=4)
+        with armed("transport.recv.timeout", times=2):
+            client.step_dispatch()  # both timeouts land on THIS dispatch RPC
+        client.step_harvest()
+        for _ in range(20):
+            if handle.status in TERMINAL_STATUSES:
+                break
+            client.step_dispatch()
+            client.step_harvest()
+        assert handle.ok
+        assert handle.result().tolist() == expected
+        assert sleeps == [0.05, 0.1]  # the deterministic backoff schedule, verbatim
+        stats = client.transport_stats()
+        assert client.retries == 2 and client.timeouts == 2
+        assert stats["retries"] == 2 and stats["timeouts"] == 2
+        assert stats["rpcs"] >= 4 and stats["frames_sent"] > stats["rpcs"] - 1
+    finally:
+        client.close()
+    assert not client.alive  # close reaped the worker process
+
+
+# ------------------------------------------------------ wedged-worker strike
+def test_worker_hang_strikes_breaker_and_fails_over(x64):
+    """``transport.worker.hang`` SIGSTOPs a worker: every attempt times out,
+    the retry budget exhausts, the client puts the wedged process down
+    (``TransportError`` — NOT the supervisor's ``WorkerDiedError`` path), the
+    breaker opens, and the victim's session finishes f64 token-identical on
+    the healthy sibling."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9], [40, 41, 42]]
+    expected = _engine_reference(model, params, prompts, [5, 5])
+
+    # rpc_timeout must be generous enough that only the SIGSTOPped worker
+    # can trip it — a healthy worker's slowest RPC here is a first-compile
+    # step, and a spurious timeout would put the SIBLING down and wedge the
+    # whole fleet behind the 512-tick cooldown (observed flaky at 1.0s)
+    router = ServingRouter(
+        model, params, num_replicas=2, num_slots=1,
+        replica_mode="process", breaker_cooldown_ticks=512,
+        transport=dict(
+            rpc_timeout_s=5.0,
+            retry=RetryPolicy(attempts=2, base_delay_s=0.01, max_delay_s=0.02,
+                              jitter=0.0),
+        ),
+    )
+    try:
+        handles = [router.submit(p, max_new_tokens=5) for p in prompts]
+        router.step()  # one session admitted per replica
+        victim = handles[0]
+        with armed("transport.worker.hang", slot=victim.replica, times=1):
+            router.run_until_drained(max_steps=300)
+        snap = router.snapshot()
+        assert [h.result().tolist() for h in handles] == expected
+        assert victim.failovers == 1
+        assert snap["breaker_transitions"].get("closed->open") == 1
+        assert snap["transport"]["worker_respawns"] == 0  # strike, not respawn
+        assert snap["transport"]["workers_alive"] == 1  # the wedge was put down
+    finally:
+        router.close()
+
+
+# ------------------------------------- full-fleet outage: parked submits live
+def test_router_journal_replays_parked_submits_after_full_fleet_crash(x64, tmp_path):
+    """ISSUE 20 acceptance: fresh submits PARKED during a full-fleet outage
+    (never accepted by any replica, so absent from every replica journal) are
+    durable in the router-level accept journal — ``ServingRouter.recover``
+    re-admits every one of them, and they finish f64 token-identical."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9], [40, 41, 42, 43], [50, 51]]
+    expected = _engine_reference(model, params, prompts, [4, 4, 4])
+
+    template = str(tmp_path / "r{i}")
+    router_dir = template.format(i="router")
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           journal=template, breaker_cooldown_ticks=512)
+    h1 = router.submit(prompts[0], max_new_tokens=4)
+    router.step()  # h1 admitted and journaled at its replica, mid-decode
+    with armed("replica.crash", slot=0, times=1):
+        router.step()  # the whole (1-replica) fleet is now breaker-open
+    parked_fresh = [router.submit(p, max_new_tokens=4) for p in prompts[1:]]
+    assert all(h.status.value == "queued" for h in parked_fresh)  # parked, not rejected
+    # the durability boundary under test: the parked FRESH submits exist
+    # nowhere but the router journal
+    assert len(read_journal(router_dir).sessions) == 2
+    assert len(read_journal(template.format(i=0)).sessions) == 1  # h1 only
+
+    # full outage: the router object is abandoned (no close, nothing flushed)
+    del router, h1
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=1, num_slots=1)
+    assert info["sessions"] == 1  # h1, from the replica journal
+    assert info["router_parked"] == 2  # both parked submits re-admitted
+    router2.run_until_drained(max_steps=500)
+    recovered = list(info["handles"]) + list(info["parked_handles"])
+    by_prompt = {tuple(h.prompt_ids.tolist()): h for h in recovered}
+    for p, want in zip(prompts, expected):
+        h = by_prompt[tuple(p)]
+        assert h.ok, f"prompt {p}: {h.status} ({h.finish_reason})"
+        assert h.result().tolist() == want, f"prompt {p} diverged after recovery"
+    # every router-journal entry was closed (dispatched -> replica journal
+    # took over): nothing would replay twice on a SECOND recovery
+    assert read_journal(router_dir).sessions == []
+    router2.close()
+
+
+def test_router_journal_dedups_sessions_already_in_replica_journals(x64, tmp_path):
+    """The dispatch race's OTHER half: a parked submit re-dispatches — the
+    replica journal's fsynced accept lands — and the process dies before the
+    router journal's close record is written. The session is live in BOTH
+    journals; recovery must admit it exactly once (the replica copy is the
+    session, the parking entry is stale)."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    [expected] = _engine_reference(model, params, [[7, 3, 9]], [6])
+    template = str(tmp_path / "r{i}")
+    router = ServingRouter(model, params, num_replicas=1, num_slots=1,
+                           journal=template, breaker_cooldown_ticks=2)
+    warm = router.submit([1, 2], max_new_tokens=2)
+    router.step()  # warm admitted: the crash below lands on a working tick
+    with armed("replica.crash", slot=0, times=1):
+        router.step()  # the whole (1-replica) fleet is breaker-open
+    h = router.submit([7, 3, 9], max_new_tokens=6)  # parked -> router journal
+    assert len(read_journal(template.format(i="router")).sessions) == 1
+    # the crash window under test: the close record is LOST (the process
+    # would have died between the replica accept and this append)
+    router._router_journal_close = lambda *a, **k: None
+    for _ in range(30):
+        router.step()  # cooldown elapses; the parked submit re-dispatches
+        if h.status.value == "running" and len(h.output_ids) >= 1:
+            break  # mid-decode: live in the replica journal, closing never ran
+    assert h.status.value == "running"
+    assert len(read_journal(template.format(i="router")).sessions) == 1
+    assert any(s.session == h.session_id
+               for s in read_journal(template.format(i=0)).sessions)
+
+    del router, warm
+    router2, info = ServingRouter.recover(model, params, template,
+                                          num_replicas=1, num_slots=1)
+    assert info["router_parked"] == 0  # deduped: the replica journal owns it
+    assert info["sessions"] == 1
+    router2.run_until_drained(max_steps=300)
+    [recovered] = info["handles"]
+    assert recovered.ok
+    assert recovered.result().tolist() == expected  # exactly once, and exact
+    router2.close()
+
+
+# -------------------------------------------------------------- kill switch
+def test_proc_replicas_kill_switch_inert(x64, monkeypatch):
+    """``PERCEIVER_IO_TPU_DISABLE_PROC_REPLICAS=1`` makes
+    ``replica_mode="process"`` construct ordinary in-process engines: no
+    worker processes, no transport snapshot block, tokens identical to the
+    default router — the pre-transport fleet, byte for byte."""
+    model, params = _make_model(param_dtype=jnp.float64)
+    prompts = [[7, 3, 9], [40, 41, 42]]
+    expected = _engine_reference(model, params, prompts, [4, 4])
+
+    monkeypatch.setenv(PROC_REPLICAS_ENV, "1")
+    assert not proc_replicas_enabled()
+    router = ServingRouter(model, params, num_replicas=2, num_slots=1,
+                           replica_mode="process")
+    try:
+        assert router._replica_mode == "inproc"
+        assert all(isinstance(r.engine, ServingEngine) for r in router.replicas)
+        handles = [router.submit(p, max_new_tokens=4) for p in prompts]
+        router.run_until_drained(max_steps=200)
+        assert [h.result().tolist() for h in handles] == expected
+        assert router.snapshot()["transport"] is None
+    finally:
+        router.close()
+
+
+def test_replica_mode_validation():
+    model, params = _make_model()
+    with pytest.raises(ValueError, match="replica_mode"):
+        ServingRouter(model, params, num_replicas=1, replica_mode="thread")
